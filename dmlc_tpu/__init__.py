@@ -1,0 +1,30 @@
+"""dmlc_tpu — a TPU-native distributed machine-learning cluster.
+
+A from-scratch rebuild of the capabilities of
+tonychang04/distributed-machine-learning-cluster (a Rust gossip-membership +
+SDFS + distributed-inference cluster; see /root/reference and SURVEY.md),
+re-designed TPU-first:
+
+- ``models``   — JAX/Flax model zoo (AlexNet, ResNet-18/50, ViT-B/16, CLIP
+                 image encoder), batched and bf16-capable, replacing the
+                 reference's tch-rs/libtorch CPU forward path
+                 (reference: src/services.rs:513-524).
+- ``ops``      — image preprocessing (decode / resize / normalize, parity with
+                 tch::vision::imagenet semantics, reference src/services.rs:492)
+                 and Pallas TPU kernels for hot post-processing ops.
+- ``parallel`` — device-mesh construction, data-parallel batched inference,
+                 sharded training step (dp/tp/sp), and ring attention for
+                 long sequences, all via jax.sharding + shard_map.
+- ``cluster``  — the distributed substrate: gossip membership + failure
+                 detection (reference src/membership.rs), the versioned
+                 replicated file store (SDFS, reference src/services.rs:83-144),
+                 the job scheduler with leader failover (src/services.rs:54-81,
+                 199-240), and the CLI (src/main.rs:85-338).
+- ``utils``    — ring topology, latency-percentile metrics, config, logging.
+
+Unlike the reference — which trickles one image per RPC at 2 qps/job — the
+scheduler here dispatches *shards* of the query list onto chips and executes
+them as large batched XLA programs, which is what the TPU's MXU wants.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,5 @@
+from dmlc_tpu.utils.ring import symmetric_ring_neighbors
+from dmlc_tpu.utils.metrics import LatencyStats
+from dmlc_tpu.utils.config import ClusterConfig
+
+__all__ = ["symmetric_ring_neighbors", "LatencyStats", "ClusterConfig"]

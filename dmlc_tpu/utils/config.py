@@ -1,0 +1,69 @@
+"""Cluster configuration.
+
+The reference compiles every constant in: leader candidates
+(src/services.rs:26-30), ports (src/membership.rs:64, src/services.rs:31-32),
+storage dirs + ssh user (src/services.rs:34-36), replication factor 4
+(src/services.rs:328,359), heartbeat 1 s / failure timeout 3 s
+(src/membership.rs:230,273), maintenance loop periods 3 s
+(src/services.rs:188,201,213,529), query interval 0.5 s (src/services.rs:408).
+
+Here all of that is a config object loadable from JSON and overridable per
+field, so fleet topology is data, not code. Defaults mirror the reference's
+constants so behavior is comparable out of the box.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+
+
+@dataclass
+class ClusterConfig:
+    # --- identity / topology ---
+    host: str = "127.0.0.1"
+    gossip_port: int = 8850          # reference: src/membership.rs:64
+    leader_port: int = 8851          # reference: src/services.rs:31
+    member_port: int = 8852          # reference: src/services.rs:32
+    leader_candidates: list[str] = field(default_factory=list)  # was LEADER_HOSTNAMES, src/services.rs:26-30
+
+    # --- membership / failure detection ---
+    heartbeat_interval_s: float = 1.0   # src/membership.rs:230
+    failure_timeout_s: float = 3.0      # src/membership.rs:273
+    ring_k: int = 2                     # k=2 symmetric ring neighbors, src/membership.rs:242
+
+    # --- SDFS ---
+    storage_dir: str = "storage"        # src/services.rs:34
+    replication_factor: int = 4         # src/services.rs:328,359
+    rereplication_interval_s: float = 3.0  # src/services.rs:188
+
+    # --- scheduler ---
+    assignment_interval_s: float = 3.0  # src/services.rs:201
+    leader_probe_interval_s: float = 3.0  # src/services.rs:529
+    # The reference throttles to 1 query / 0.5 s per job (src/services.rs:408).
+    # TPU-native dispatch is shard-based; this is the *shard* size per dispatch.
+    dispatch_shard_size: int = 64
+    rpc_concurrency: int = 10           # src/main.rs:61,79
+
+    # --- inference engine ---
+    batch_size: int = 256
+    model_dtype: str = "bfloat16"
+    data_dir: str = "test_files/imagenet_1k/train"
+    synset_path: str = "synset_words.txt"
+
+    def with_updates(self, **kw) -> "ClusterConfig":
+        return dataclasses.replace(self, **kw)
+
+    @classmethod
+    def from_json(cls, path: str | Path) -> "ClusterConfig":
+        raw = json.loads(Path(path).read_text())
+        names = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(raw) - names
+        if unknown:
+            raise ValueError(f"unknown config keys: {sorted(unknown)}")
+        return cls(**raw)
+
+    def to_json(self, path: str | Path) -> None:
+        Path(path).write_text(json.dumps(dataclasses.asdict(self), indent=2))

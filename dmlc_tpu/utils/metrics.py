@@ -1,0 +1,74 @@
+"""Latency/accuracy metrics with percentile reporting.
+
+Capability parity with the reference's ``jobs`` report, which aggregates
+per-query wall-clock durations into mean/std/median/p90/p95/p99 via the
+``histogram`` crate (reference: src/main.rs:282-309) and tracks
+correct/finished counts per job (src/services.rs:74-80).
+
+Here durations are recorded per *batch* as well as per *query* — on TPU the
+unit of execution is a sharded batch, so we keep both: per-batch device
+latency (what the chip did) and per-query amortized latency (what the
+reference reported).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+
+@dataclass
+class LatencyStats:
+    """Streaming collection of durations (seconds) with percentile summary."""
+
+    samples: list[float] = field(default_factory=list)
+
+    def record(self, seconds: float) -> None:
+        self.samples.append(float(seconds))
+
+    def extend(self, seconds: list[float]) -> None:
+        self.samples.extend(float(s) for s in seconds)
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def percentile(self, p: float) -> float:
+        """Nearest-rank percentile, p in [0, 100]."""
+        if not self.samples:
+            return float("nan")
+        xs = sorted(self.samples)
+        rank = max(1, math.ceil(p / 100.0 * len(xs)))
+        return xs[min(rank, len(xs)) - 1]
+
+    @property
+    def mean(self) -> float:
+        return sum(self.samples) / len(self.samples) if self.samples else float("nan")
+
+    @property
+    def std(self) -> float:
+        if len(self.samples) < 2:
+            return 0.0 if self.samples else float("nan")
+        m = self.mean
+        return math.sqrt(sum((x - m) ** 2 for x in self.samples) / (len(self.samples) - 1))
+
+    def summary(self) -> dict[str, float]:
+        """The reference's report shape: mean/std/median/p90/p95/p99."""
+        return {
+            "count": float(len(self.samples)),
+            "mean": self.mean,
+            "std": self.std,
+            "median": self.percentile(50),
+            "p90": self.percentile(90),
+            "p95": self.percentile(95),
+            "p99": self.percentile(99),
+        }
+
+    def merge(self, other: "LatencyStats") -> None:
+        self.samples.extend(other.samples)
+
+    def to_wire(self) -> list[float]:
+        return list(self.samples)
+
+    @classmethod
+    def from_wire(cls, samples: list[float]) -> "LatencyStats":
+        return cls(samples=list(samples))

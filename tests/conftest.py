@@ -1,0 +1,20 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax import.
+
+Multi-chip hardware is not available in CI; all sharding/collective tests run
+on a virtual 8-device CPU mesh (jax's xla_force_host_platform_device_count),
+which exercises the same pjit/shard_map partitioning logic the TPU pod path
+uses. Real-TPU execution is covered by bench.py on the driver side.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Make the repo root importable regardless of how pytest was invoked.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)

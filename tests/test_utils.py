@@ -1,0 +1,93 @@
+"""Tests for utils: ring topology (parity with reference utils.rs:29-92 cases),
+latency percentile metrics, and config round-trip."""
+
+import math
+
+import pytest
+
+from dmlc_tpu.utils.ring import symmetric_ring_neighbors
+from dmlc_tpu.utils.metrics import LatencyStats
+from dmlc_tpu.utils.config import ClusterConfig
+
+
+class TestRingNeighbors:
+    def test_basic_window(self):
+        # Mirrors the reference's basic-window unit test (utils.rs:33-65):
+        # interior node gets k predecessors and k successors.
+        ids = list(range(10))
+        got = symmetric_ring_neighbors(ids, 5, 2)
+        assert sorted(got) == [3, 4, 6, 7]
+
+    def test_wrap_around(self):
+        # Mirrors utils.rs:67-80: windows wrap around the ring ends.
+        ids = list(range(10))
+        got = symmetric_ring_neighbors(ids, 0, 2)
+        assert sorted(got) == [1, 2, 8, 9]
+        got = symmetric_ring_neighbors(ids, 9, 2)
+        assert sorted(got) == [0, 1, 7, 8]
+
+    def test_small_ring_dedup(self):
+        # Mirrors utils.rs:82-91: overlapping windows deduplicate.
+        ids = [1, 2, 3]
+        got = symmetric_ring_neighbors(ids, 2, 2)
+        assert sorted(got) == [1, 3]
+
+    def test_self_not_in_ids(self):
+        got = symmetric_ring_neighbors([1, 3, 5, 7], 4, 1)
+        assert sorted(got) == [3, 5]
+
+    def test_predicate_filter(self):
+        # The gossip layer filters to Active members (membership.rs:242-246).
+        ids = list(range(10))
+        got = symmetric_ring_neighbors(ids, 5, 2, predicate=lambda x: x % 2 == 0)
+        assert sorted(got) == [2, 4, 6, 8]  # odd ids excluded before windowing
+
+    def test_empty_and_zero_k(self):
+        assert symmetric_ring_neighbors([], 1, 2) == []
+        assert symmetric_ring_neighbors([1, 2], 1, 0) == []
+        assert symmetric_ring_neighbors([5], 5, 2) == []
+
+
+class TestLatencyStats:
+    def test_summary_shape(self):
+        s = LatencyStats()
+        s.extend([0.1 * i for i in range(1, 101)])
+        out = s.summary()
+        assert out["count"] == 100
+        assert out["median"] == pytest.approx(5.0)
+        assert out["p90"] == pytest.approx(9.0)
+        assert out["p99"] == pytest.approx(9.9)
+        assert out["mean"] == pytest.approx(5.05)
+
+    def test_empty(self):
+        s = LatencyStats()
+        assert math.isnan(s.summary()["mean"])
+
+    def test_wire_roundtrip_and_merge(self):
+        a = LatencyStats([1.0, 2.0])
+        b = LatencyStats.from_wire(a.to_wire())
+        assert b.samples == [1.0, 2.0]
+        b.merge(LatencyStats([3.0]))
+        assert len(b) == 3
+
+
+class TestConfig:
+    def test_defaults_mirror_reference_constants(self):
+        c = ClusterConfig()
+        assert c.gossip_port == 8850 and c.leader_port == 8851 and c.member_port == 8852
+        assert c.replication_factor == 4
+        assert c.heartbeat_interval_s == 1.0 and c.failure_timeout_s == 3.0
+        assert c.ring_k == 2
+
+    def test_json_roundtrip(self, tmp_path):
+        c = ClusterConfig(host="10.0.0.1", leader_candidates=["a", "b", "c"])
+        p = tmp_path / "cfg.json"
+        c.to_json(p)
+        c2 = ClusterConfig.from_json(p)
+        assert c2 == c
+
+    def test_unknown_key_rejected(self, tmp_path):
+        p = tmp_path / "cfg.json"
+        p.write_text('{"nope": 1}')
+        with pytest.raises(ValueError):
+            ClusterConfig.from_json(p)
