@@ -1,0 +1,57 @@
+"""AlexNet in Flax.
+
+Replaces the reference's ``tch::vision::alexnet`` graph + ``alexnet.ot`` load
+(reference: src/services.rs:520-524). Topology matches the canonical
+(torchvision-style) AlexNet so common checkpoints map 1:1; written from
+scratch in NHWC with bf16 compute / fp32 params.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class AlexNet(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def conv(x, features, kernel, stride=1, pad=0, name=None):
+            return nn.Conv(
+                features,
+                (kernel, kernel),
+                (stride, stride),
+                padding=[(pad, pad), (pad, pad)],
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+                name=name,
+            )(x)
+
+        x = x.astype(self.dtype)
+        x = nn.relu(conv(x, 64, 11, stride=4, pad=2, name="conv1"))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(x, 192, 5, pad=2, name="conv2"))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(x, 384, 3, pad=1, name="conv3"))
+        x = nn.relu(conv(x, 256, 3, pad=1, name="conv4"))
+        x = nn.relu(conv(x, 256, 3, pad=1, name="conv5"))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        # torchvision flattens CHW; we transpose NHWC→NCHW before flattening so
+        # the classifier weight layout matches torchvision checkpoints.
+        x = jnp.transpose(x, (0, 3, 1, 2)).reshape((x.shape[0], -1))
+        dense = lambda f, name: nn.Dense(f, dtype=self.dtype, param_dtype=jnp.float32, name=name)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(dense(4096, "fc1")(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(dense(4096, "fc2")(x))
+        x = dense(self.num_classes, "head")(x)
+        return x.astype(jnp.float32)
+
+
+def alexnet(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> AlexNet:
+    return AlexNet(num_classes=num_classes, dtype=dtype)

@@ -1,0 +1,80 @@
+"""CLIP image encoder (ViT-L/14 class) in Flax for batch embedding.
+
+Required by BASELINE.json config "CLIP ViT-L/14 image-encoder batch embedding
+(bf16)". From-scratch implementation whose parameter layout maps onto
+HuggingFace ``CLIPVisionModelWithProjection`` so parity is testable offline.
+
+Differences from the classification ViT (models/vit.py):
+- a pre-encoder LayerNorm after the embeddings (``pre_layrnorm`` in HF),
+- quick-GELU activation, eps 1e-5,
+- pooled output = post-LN of the CLS token, then a bias-free projection to the
+  shared embedding space.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from dmlc_tpu.models.vit import TransformerBlock
+
+
+class CLIPVisionEncoder(nn.Module):
+    projection_dim: int = 768
+    patch_size: int = 14
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    mlp_dim: int = 4096
+    dtype: Any = jnp.bfloat16
+    layer_norm_eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b = x.shape[0]
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.hidden_size,
+            (self.patch_size, self.patch_size),
+            (self.patch_size, self.patch_size),
+            padding="VALID",
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="patch_embed",
+        )(x)
+        x = x.reshape(b, -1, self.hidden_size)
+        cls = self.param("cls_token", nn.initializers.normal(0.02), (1, 1, self.hidden_size), jnp.float32)
+        x = jnp.concatenate([jnp.tile(cls.astype(self.dtype), (b, 1, 1)), x], axis=1)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, x.shape[1], self.hidden_size), jnp.float32
+        )
+        x = x + pos.astype(self.dtype)
+        ln = lambda name: nn.LayerNorm(epsilon=self.layer_norm_eps, dtype=self.dtype, param_dtype=jnp.float32, name=name)
+        x = ln("pre_ln")(x)
+        for i in range(self.num_layers):
+            x = TransformerBlock(
+                self.num_heads,
+                self.mlp_dim,
+                dtype=self.dtype,
+                layer_norm_eps=self.layer_norm_eps,
+                activation="quick_gelu",
+                name=f"block{i}",
+            )(x)
+        pooled = ln("post_ln")(x[:, 0])
+        embeds = nn.Dense(
+            self.projection_dim, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32, name="projection"
+        )(pooled)
+        return embeds.astype(jnp.float32)
+
+
+def clip_vit_l14(dtype: Any = jnp.bfloat16) -> CLIPVisionEncoder:
+    return CLIPVisionEncoder(dtype=dtype)
+
+
+def clip_vit_b32(dtype: Any = jnp.bfloat16) -> CLIPVisionEncoder:
+    return CLIPVisionEncoder(
+        projection_dim=512, patch_size=32, hidden_size=768, num_layers=12, num_heads=12, mlp_dim=3072, dtype=dtype
+    )

@@ -1,0 +1,71 @@
+"""Model registry.
+
+The reference statically defines exactly two jobs, "resnet18" and "alexnet"
+(src/services.rs:168-169), with models loaded eagerly at member startup
+(src/services.rs:513-524). Here models are looked up by name from a registry
+that also carries the input geometry, so the scheduler, CLI, and bench all
+agree on model identity by string name — including the BASELINE.json extras
+(resnet50, vit_b16, clip_vit_l14).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from dmlc_tpu.models.alexnet import alexnet
+from dmlc_tpu.models.clip import clip_vit_b32, clip_vit_l14
+from dmlc_tpu.models.resnet import resnet18, resnet34, resnet50
+from dmlc_tpu.models.vit import vit_b16, vit_l14
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    name: str
+    build: Callable[..., Any]          # (dtype=...) -> nn.Module
+    input_size: int                    # square image side
+    num_outputs: int                   # classes, or embedding dim for encoders
+    classifier: bool = True            # False => embedding model (no top-1/accuracy)
+
+    def module(self, dtype=jnp.bfloat16):
+        if self.classifier:
+            return self.build(num_classes=self.num_outputs, dtype=dtype)
+        return self.build(dtype=dtype)
+
+    def init_params(self, rng, dtype=jnp.bfloat16, batch_size: int = 1):
+        model = self.module(dtype=dtype)
+        dummy = jnp.zeros((batch_size, self.input_size, self.input_size, 3), jnp.float32)
+        return model, model.init(rng, dummy, train=False)
+
+
+_REGISTRY: dict[str, ModelSpec] = {}
+
+
+def register(spec: ModelSpec) -> None:
+    _REGISTRY[spec.name] = spec
+
+
+def get_model(name: str) -> ModelSpec:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def list_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+for _spec in [
+    ModelSpec("resnet18", resnet18, 224, 1000),
+    ModelSpec("resnet34", resnet34, 224, 1000),
+    ModelSpec("resnet50", resnet50, 224, 1000),
+    ModelSpec("alexnet", alexnet, 224, 1000),
+    ModelSpec("vit_b16", vit_b16, 224, 1000),
+    ModelSpec("vit_l14", vit_l14, 224, 1000),
+    ModelSpec("clip_vit_l14", clip_vit_l14, 224, 768, classifier=False),
+    ModelSpec("clip_vit_b32", clip_vit_b32, 224, 512, classifier=False),
+]:
+    register(_spec)

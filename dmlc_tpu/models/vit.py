@@ -1,0 +1,146 @@
+"""Vision Transformer (ViT-B/16 class) in Flax, TPU-first.
+
+The reference has no attention models at all (fixed 224x224 CNNs,
+src/services.rs:492); BASELINE.json adds ViT-B/16 classification and CLIP
+ViT-L/14 embedding as required configs. This is a from-scratch ViT whose
+parameter layout maps 1:1 onto HuggingFace ``ViTModel`` weights (q/k/v/out
+projections as separate [D, D] matrices) so parity can be tested against
+``transformers`` without any network access.
+
+TPU notes: attention and MLP are plain einsum/matmul chains — XLA fuses the
+softmax chain and tiles the matmuls onto the MXU; sequence length is static
+(197 for 224/16). Long-sequence variants run through
+``dmlc_tpu.parallel.ring_attention`` instead of this dense path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+def quick_gelu(x):
+    return x * nn.sigmoid(1.702 * x)
+
+
+def gelu_exact(x):
+    # erf-based GELU (what torch/HF "gelu" means); flax's default is the tanh
+    # approximation, which breaks bitwise parity with reference checkpoints.
+    return nn.gelu(x, approximate=False)
+
+
+ACTIVATIONS: dict[str, Callable] = {"gelu": gelu_exact, "quick_gelu": quick_gelu}
+
+
+class MultiHeadAttention(nn.Module):
+    """Standard MHA with separate q/k/v/out projections (HF-compatible layout)."""
+
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        assert d % self.num_heads == 0
+        head_dim = d // self.num_heads
+        dense = lambda name: nn.Dense(d, dtype=self.dtype, param_dtype=jnp.float32, name=name)
+        q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
+
+        def split(t):  # [B, S, D] -> [B, H, S, hd]
+            return t.reshape(t.shape[0], t.shape[1], self.num_heads, head_dim).transpose(0, 2, 1, 3)
+
+        q, k, v = split(q), split(k), split(v)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(head_dim).astype(np.float32)
+        probs = nn.softmax(scores.astype(jnp.float32), axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], d)
+        return dense("out")(out)
+
+
+class TransformerBlock(nn.Module):
+    """Pre-LN transformer block: LN→MHA→res, LN→MLP→res."""
+
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+    layer_norm_eps: float = 1e-12
+    activation: str = "gelu"
+
+    @nn.compact
+    def __call__(self, x):
+        ln = lambda name: nn.LayerNorm(epsilon=self.layer_norm_eps, dtype=self.dtype, param_dtype=jnp.float32, name=name)
+        y = ln("ln1")(x)
+        y = MultiHeadAttention(self.num_heads, dtype=self.dtype, name="attn")(y)
+        x = x + y
+        y = ln("ln2")(x)
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype, param_dtype=jnp.float32, name="mlp_in")(y)
+        y = ACTIVATIONS[self.activation](y)
+        y = nn.Dense(x.shape[-1], dtype=self.dtype, param_dtype=jnp.float32, name="mlp_out")(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    """ViT encoder for classification. Input NHWC images, output logits."""
+
+    num_classes: int = 1000
+    patch_size: int = 16
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dtype: Any = jnp.bfloat16
+    layer_norm_eps: float = 1e-12
+    activation: str = "gelu"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b = x.shape[0]
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.hidden_size,
+            (self.patch_size, self.patch_size),
+            (self.patch_size, self.patch_size),
+            padding="VALID",
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="patch_embed",
+        )(x)
+        x = x.reshape(b, -1, self.hidden_size)  # [B, S, D]
+        cls = self.param("cls_token", nn.initializers.zeros, (1, 1, self.hidden_size), jnp.float32)
+        x = jnp.concatenate([jnp.tile(cls.astype(self.dtype), (b, 1, 1)), x], axis=1)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, x.shape[1], self.hidden_size), jnp.float32
+        )
+        x = x + pos.astype(self.dtype)
+        for i in range(self.num_layers):
+            x = TransformerBlock(
+                self.num_heads,
+                self.mlp_dim,
+                dtype=self.dtype,
+                layer_norm_eps=self.layer_norm_eps,
+                activation=self.activation,
+                name=f"block{i}",
+            )(x)
+        x = nn.LayerNorm(epsilon=self.layer_norm_eps, dtype=self.dtype, param_dtype=jnp.float32, name="ln_final")(x)
+        cls_out = x[:, 0]
+        logits = nn.Dense(self.num_classes, dtype=self.dtype, param_dtype=jnp.float32, name="head")(cls_out)
+        return logits.astype(jnp.float32)
+
+
+def vit_b16(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ViT:
+    return ViT(num_classes=num_classes, dtype=dtype)
+
+
+def vit_l14(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ViT:
+    return ViT(
+        num_classes=num_classes,
+        patch_size=14,
+        hidden_size=1024,
+        num_layers=24,
+        num_heads=16,
+        mlp_dim=4096,
+        dtype=dtype,
+    )

@@ -1,0 +1,98 @@
+"""Image preprocessing: decode, resize, ImageNet-normalize, batch.
+
+Capability parity with the reference's
+``tch::vision::imagenet::load_image_and_resize(path, 224, 224)`` +
+normalization (reference: src/services.rs:492): decode a JPEG, resize to the
+model's input size, scale to [0,1], normalize with the ImageNet mean/std, and
+also the label utilities around ``synset_words.txt`` (src/services.rs:170-184)
+and per-class fixture lookup (src/services.rs:485-490).
+
+Design split, TPU-first:
+- **Host side** (numpy/PIL): decode + resize, returns uint8 HWC. JPEG decode
+  cannot run on the TPU; at >10k img/s it must be overlapped with device
+  compute, which the batch loader does with a thread pool.
+- **Device side** (jax, fused into the model's first conv by XLA, or the
+  Pallas kernel in ops/pallas_kernels.py): uint8 -> float, /255, (x-mean)/std.
+  Shipping uint8 to the device cuts host->HBM transfer bytes 4x vs fp32.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+from pathlib import Path
+from typing import Iterable, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+CLIP_MEAN = np.array([0.48145466, 0.4578275, 0.40821073], np.float32)
+CLIP_STD = np.array([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+
+def load_synset_words(path: str | Path) -> list[tuple[str, str]]:
+    """Parse synset_words.txt lines 'n01440764 tench, Tinca tinca' ->
+    [(synset_id, label), ...] in file order. The file order defines the class
+    index order (reference: src/services.rs:170-184), and the list doubles as
+    the query workload for the scheduler."""
+    out: list[tuple[str, str]] = []
+    for line in Path(path).read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        synset, _, label = line.partition(" ")
+        out.append((synset, label))
+    return out
+
+
+def class_image_path(data_dir: str | Path, synset: str) -> Path:
+    """First image in the per-class fixture directory
+    (reference: src/services.rs:485-490 picks the first dir entry)."""
+    d = Path(data_dir) / synset
+    files = sorted(p for p in d.iterdir() if p.is_file())
+    if not files:
+        raise FileNotFoundError(f"no images under {d}")
+    return files[0]
+
+
+def decode_resize(path: str | Path, size: int = 224) -> np.ndarray:
+    """JPEG/PNG -> uint8 [size, size, 3] RGB, bilinear resize.
+
+    Matches tch's load_image_and_resize semantics: direct resize to the target
+    square (not resize-shortest-side + center-crop)."""
+    from PIL import Image
+
+    with Image.open(path) as im:
+        im = im.convert("RGB").resize((size, size), Image.BILINEAR)
+        return np.asarray(im, dtype=np.uint8)
+
+
+def load_batch(
+    paths: Sequence[str | Path], size: int = 224, workers: int | None = None
+) -> np.ndarray:
+    """Decode+resize a batch with a thread pool -> uint8 [N, size, size, 3].
+
+    PIL decode releases the GIL, so threads scale on the host cores; this is
+    the stage that must keep up with the TPU (SURVEY.md §7 hard part b)."""
+    if not paths:
+        return np.zeros((0, size, size, 3), np.uint8)
+    workers = workers or min(32, (os.cpu_count() or 8))
+    if len(paths) == 1 or workers == 1:
+        return np.stack([decode_resize(p, size) for p in paths])
+    with concurrent.futures.ThreadPoolExecutor(max_workers=workers) as pool:
+        return np.stack(list(pool.map(lambda p: decode_resize(p, size), paths)))
+
+
+def normalize(batch_u8, mean: np.ndarray = IMAGENET_MEAN, std: np.ndarray = IMAGENET_STD):
+    """Device-side: uint8 NHWC -> normalized float32 NHWC. Under jit, XLA fuses
+    this into the consumer; the Pallas variant exists for the standalone path."""
+    x = jnp.asarray(batch_u8).astype(jnp.float32) / 255.0
+    return (x - jnp.asarray(mean)) / jnp.asarray(std)
+
+
+def stats_for_model(model_name: str) -> tuple[np.ndarray, np.ndarray]:
+    if model_name.startswith("clip"):
+        return CLIP_MEAN, CLIP_STD
+    return IMAGENET_MEAN, IMAGENET_STD

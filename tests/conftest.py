@@ -18,3 +18,12 @@ if "xla_force_host_platform_device_count" not in flags:
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
+
+# The environment's sitecustomize registers a remote-TPU PJRT plugin and
+# force-selects it via jax.config.update("jax_platforms", "axon,cpu") at
+# interpreter startup, which overrides the JAX_PLATFORMS env var and makes the
+# first backend touch block on the TPU tunnel. Tests must run hermetically on
+# the virtual CPU mesh, so explicitly select cpu at the config level too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,97 @@
+"""Model zoo tests: shapes, jit-compilability, registry, dtype policy.
+
+This machine has a single CPU core, so full-size (224x224) compiled forwards
+are reserved for the reference's own two models (resnet18/alexnet, the jobs in
+src/services.rs:168-169); the other families are exercised at reduced
+spatial size (ResNet is fully convolutional; ViT/CLIP use small test configs).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dmlc_tpu.models import get_model, list_models
+from dmlc_tpu.models.clip import CLIPVisionEncoder
+from dmlc_tpu.models.resnet import resnet50
+from dmlc_tpu.models.vit import ViT
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+def test_resnet18_forward_224(rng):
+    spec = get_model("resnet18")
+    model, variables = spec.init_params(rng, dtype=jnp.float32)
+    x = jax.random.normal(rng, (2, 224, 224, 3))
+    logits = jax.jit(lambda v, x: model.apply(v, x, train=False))(variables, x)
+    assert logits.shape == (2, 1000)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_alexnet_forward_224(rng):
+    spec = get_model("alexnet")
+    model, variables = spec.init_params(rng, dtype=jnp.float32)
+    x = jax.random.normal(rng, (2, 224, 224, 3))
+    logits = jax.jit(lambda v, x: model.apply(v, x, train=False))(variables, x)
+    assert logits.shape == (2, 1000)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_resnet50_small_input(rng):
+    model = resnet50(num_classes=10, dtype=jnp.float32)
+    x = jax.random.normal(rng, (2, 64, 64, 3))
+    variables = model.init(rng, x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+
+
+def test_vit_tiny_config(rng):
+    model = ViT(num_classes=10, patch_size=8, hidden_size=64, num_layers=2, num_heads=4, mlp_dim=128, dtype=jnp.float32)
+    x = jax.random.normal(rng, (2, 32, 32, 3))
+    variables = model.init(rng, x, train=False)
+    logits = jax.jit(lambda v, x: model.apply(v, x, train=False))(variables, x)
+    assert logits.shape == (2, 10)
+
+
+def test_clip_tiny_config(rng):
+    model = CLIPVisionEncoder(
+        projection_dim=32, patch_size=8, hidden_size=64, num_layers=2, num_heads=4, mlp_dim=128, dtype=jnp.float32
+    )
+    x = jax.random.normal(rng, (2, 32, 32, 3))
+    variables = model.init(rng, x, train=False)
+    embeds = model.apply(variables, x, train=False)
+    assert embeds.shape == (2, 32)
+
+
+def test_registry_contents():
+    names = list_models()
+    # BASELINE.json configs all present.
+    for required in ["resnet18", "alexnet", "resnet50", "vit_b16", "clip_vit_l14"]:
+        assert required in names
+    with pytest.raises(KeyError):
+        get_model("nope")
+
+
+def test_resnet_train_mode_updates_batch_stats(rng):
+    model = resnet50(num_classes=10, dtype=jnp.float32)
+    x = jax.random.normal(rng, (2, 64, 64, 3))
+    variables = model.init(rng, x, train=False)
+    logits, mutated = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    assert logits.shape == (2, 10)
+    leaf0 = jax.tree_util.tree_leaves(variables["batch_stats"])[0]
+    leaf1 = jax.tree_util.tree_leaves(mutated["batch_stats"])[0]
+    assert not np.allclose(np.asarray(leaf0), np.asarray(leaf1))
+
+
+def test_bf16_compute_fp32_params(rng):
+    model = resnet50(num_classes=10, dtype=jnp.bfloat16)
+    x = jax.random.normal(rng, (1, 32, 32, 3))
+    variables = model.init(rng, x, train=False)
+    for leaf in jax.tree_util.tree_leaves(variables["params"]):
+        assert leaf.dtype == jnp.float32  # bf16 is compute dtype, not storage dtype
+    out = model.apply(variables, x, train=False)
+    assert out.dtype == jnp.float32  # logits re-materialized in fp32

@@ -1,0 +1,75 @@
+"""Preprocessing tests: decode/resize/normalize semantics and label parsing."""
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.ops import preprocess as pp
+
+
+@pytest.fixture(scope="module")
+def fixture_dataset(tmp_path_factory):
+    """Tiny generated imagenet-style fixture: <root>/<synset>/img.jpg per class,
+    plus a synset_words file — same shape as the reference's
+    test_files/imagenet_1k/train + synset_words.txt corpus (SURVEY.md C21)."""
+    from PIL import Image
+
+    root = tmp_path_factory.mktemp("imagenet_fixture")
+    data = root / "train"
+    rng = np.random.RandomState(0)
+    lines = []
+    for i in range(8):
+        synset = f"n{i:08d}"
+        label = f"class {i}, fake"
+        lines.append(f"{synset} {label}")
+        d = data / synset
+        d.mkdir(parents=True)
+        arr = rng.randint(0, 255, (64 + i, 48 + i, 3), np.uint8)
+        Image.fromarray(arr).save(d / "img.jpg", quality=95)
+    (root / "synset_words.txt").write_text("\n".join(lines) + "\n")
+    return root
+
+
+def test_load_synset_words(fixture_dataset):
+    pairs = pp.load_synset_words(fixture_dataset / "synset_words.txt")
+    assert len(pairs) == 8
+    assert pairs[0] == ("n00000000", "class 0, fake")
+    assert pairs[3][0] == "n00000003"
+
+
+def test_class_image_path(fixture_dataset):
+    p = pp.class_image_path(fixture_dataset / "train", "n00000002")
+    assert p.name == "img.jpg"
+    with pytest.raises(FileNotFoundError):
+        pp.class_image_path(fixture_dataset / "train", "n99999999")
+
+
+def test_decode_resize_shape_dtype(fixture_dataset):
+    p = pp.class_image_path(fixture_dataset / "train", "n00000000")
+    img = pp.decode_resize(p, 224)
+    assert img.shape == (224, 224, 3) and img.dtype == np.uint8
+    img96 = pp.decode_resize(p, 96)
+    assert img96.shape == (96, 96, 3)
+
+
+def test_load_batch_matches_single(fixture_dataset):
+    paths = [pp.class_image_path(fixture_dataset / "train", f"n{i:08d}") for i in range(8)]
+    batch = pp.load_batch(paths, size=64)
+    assert batch.shape == (8, 64, 64, 3)
+    single = pp.decode_resize(paths[3], 64)
+    np.testing.assert_array_equal(batch[3], single)
+
+
+def test_normalize_values():
+    u8 = np.zeros((1, 2, 2, 3), np.uint8)
+    out = np.asarray(pp.normalize(u8))
+    # 0 -> (0 - mean)/std exactly
+    expect = (0.0 - pp.IMAGENET_MEAN) / pp.IMAGENET_STD
+    np.testing.assert_allclose(out[0, 0, 0], expect, rtol=1e-6)
+    u8 = np.full((1, 1, 1, 3), 255, np.uint8)
+    out = np.asarray(pp.normalize(u8, pp.CLIP_MEAN, pp.CLIP_STD))
+    expect = (1.0 - pp.CLIP_MEAN) / pp.CLIP_STD
+    np.testing.assert_allclose(out[0, 0, 0], expect, rtol=1e-5)
+
+
+def test_empty_batch():
+    assert pp.load_batch([], size=32).shape == (0, 32, 32, 3)
