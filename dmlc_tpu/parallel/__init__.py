@@ -1,0 +1,17 @@
+from dmlc_tpu.parallel.mesh import (
+    batch_sharding,
+    make_mesh,
+    param_shardings,
+    param_spec,
+    replicated,
+    shard_params,
+)
+from dmlc_tpu.parallel.inference import BatchResult, InferenceEngine
+from dmlc_tpu.parallel.ring_attention import dense_attention, ring_attention
+from dmlc_tpu.parallel.train import (
+    TrainState,
+    create_train_state,
+    default_optimizer,
+    make_train_step,
+    state_shardings,
+)

@@ -1,0 +1,90 @@
+"""Ring attention: sequence/context parallelism over the mesh's ``sp`` axis.
+
+The reference has no sequence dimension anywhere (fixed 224x224 CNNs,
+SURVEY.md §5 "long-context: entirely absent"), but long-context is first-class
+here: sequences too long for one chip's HBM are sharded over ``sp``, each
+device keeps its Q block resident, and K/V blocks rotate around the ring via
+``ppermute`` (one ICI hop per step) while a numerically-stable online-softmax
+(flash-attention style) accumulator absorbs each block. Peak memory per chip
+is O(S/n) with n devices, compute overlaps the rotation, and no device ever
+materializes the full [S, S] score matrix.
+
+Implementation is `shard_map` over the mesh — the collective schedule is
+explicit (ppermute), everything inside is plain jax the compiler can fuse.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
+    """Per-device body. q/k/v: [B, H, S_local, Dh] (this device's sequence block)."""
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    s_local = q.shape[2]
+    q32 = q.astype(jnp.float32) * scale
+
+    def one_block(carry, step):
+        o, m, l, k_blk, v_blk = carry
+        # Which global block the ring currently delivered to us: blocks move
+        # to the next device each step, so at step i we hold (my_idx - i) % n.
+        src = (my_idx - step) % n
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32))
+        if causal:
+            q_pos = my_idx * s_local + jnp.arange(s_local)
+            k_pos = src * s_local + jnp.arange(k_blk.shape[2])
+            mask = k_pos[None, :] <= q_pos[:, None]
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        # exp(-inf - -inf) guards: where a row is fully masked m_new stays -inf;
+        # correction must then be 1, not nan.
+        corr = jnp.where(jnp.isneginf(m_new), 1.0, jnp.exp(m - m_new))
+        p = jnp.exp(scores - m_new[..., None])
+        p = jnp.where(jnp.isneginf(scores), 0.0, p)
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        k_nxt, v_nxt = lax.ppermute(
+            (k_blk, v_blk), axis_name, perm=[(j, (j + 1) % n) for j in range(n)]
+        )
+        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+
+    # Derive the zero carries from q32 so they inherit its varying-manual-axes
+    # set (jax >= 0.9 vma tracking): the scan carry type must match the output,
+    # which varies over every mesh axis q does (sp, and dp if batch-sharded).
+    o0 = jnp.zeros_like(q32)
+    m0 = jnp.full_like(q32[..., 0], -jnp.inf)
+    l0 = jnp.zeros_like(q32[..., 0])
+    (o, m, l, _, _), _ = lax.scan(one_block, (o0, m0, l0, k, v), jnp.arange(n))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q, k, v, mesh: Mesh, *, axis_name: str = "sp", causal: bool = False, scale: float | None = None
+):
+    """Sequence-parallel attention. q/k/v: [B, H, S, Dh] with S sharded over
+    ``axis_name`` in ``mesh``; returns [B, H, S, Dh] with the same sharding."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    spec = P(None, None, axis_name, None)
+    fn = partial(_ring_attention_local, axis_name=axis_name, causal=causal, scale=scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+
+
+def dense_attention(q, k, v, *, causal: bool = False, scale: float | None = None):
+    """Reference single-device attention for parity tests."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32) * scale, k.astype(jnp.float32))
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.arange(s_k)[None, :] <= jnp.arange(s_q)[:, None]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,116 @@
+"""Sharded training step (dp x tp) over a device mesh.
+
+The reference's "train" command only broadcasts pretrained weight files
+(src/services.rs:139-144, README.md:21) — there is no gradient step anywhere.
+This module supplies the real thing, TPU-first: a jit-compiled SPMD train step
+where the batch is sharded over ``dp``, attention/MLP parameters over ``tp``
+(Megatron-style, see parallel/mesh.py:param_spec), and XLA inserts the
+gradient psum over dp and the activation collectives over tp automatically.
+
+Works for both model families in the zoo: BatchNorm CNNs (ResNet — carries
+``batch_stats``) and transformers (ViT/CLIP).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dmlc_tpu.parallel import mesh as mesh_lib
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    batch_stats: Any  # None for transformers
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads, new_batch_stats=None):
+        updates, new_opt = self.tx.update(grads, self.opt_state, self.params)
+        return self.replace(
+            step=self.step + 1,
+            params=optax.apply_updates(self.params, updates),
+            opt_state=new_opt,
+            batch_stats=new_batch_stats if new_batch_stats is not None else self.batch_stats,
+        )
+
+
+def create_train_state(model, variables, tx) -> TrainState:
+    params = variables["params"]
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=tx.init(params),
+        batch_stats=variables.get("batch_stats"),
+        apply_fn=model.apply,
+        tx=tx,
+    )
+
+
+def state_shardings(mesh: Mesh, state: TrainState, tp_axis: str = "tp"):
+    """NamedShardings for the full train state.
+
+    Optimizer moments mirror the param tree (their tree paths end with the
+    same module/leaf names), so the single path-based rule in
+    mesh_lib.param_spec covers params, mu, and nu alike; scalars and
+    batch_stats fall through to replicated.
+    """
+    has_tp = tp_axis in mesh.axis_names
+
+    def one(path, leaf):
+        names = tuple(str(getattr(p, "key", getattr(p, "name", p))) for p in path)
+        spec = mesh_lib.param_spec(names, leaf, tp_axis) if has_tp and hasattr(leaf, "ndim") else P()
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(one, state)
+
+
+def cross_entropy(logits, labels):
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def make_train_step(mesh: Mesh, state: TrainState, dp_axis: str = "dp", tp_axis: str = "tp"):
+    """Returns (sharded_state, step_fn). step_fn(state, images_f32, labels) ->
+    (state, metrics). One compiled SPMD program; state is donated."""
+    shd = state_shardings(mesh, state, tp_axis)
+    state = jax.tree_util.tree_map(jax.device_put, state, shd)
+    data_shd = NamedSharding(mesh, P(dp_axis))
+    label_shd = NamedSharding(mesh, P(dp_axis))
+    has_bn = state.batch_stats is not None
+
+    def step_fn(state: TrainState, images, labels):
+        def loss_fn(params):
+            variables = {"params": params}
+            if has_bn:
+                variables["batch_stats"] = state.batch_stats
+                logits, mut = state.apply_fn(variables, images, train=True, mutable=["batch_stats"])
+                return cross_entropy(logits, labels), (logits, mut["batch_stats"])
+            logits = state.apply_fn(variables, images, train=True)
+            return cross_entropy(logits, labels), (logits, None)
+
+        (loss, (logits, new_bn)), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        new_state = state.apply_gradients(grads, new_batch_stats=new_bn)
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        return new_state, {"loss": loss, "accuracy": acc}
+
+    metric_shd = {"loss": NamedSharding(mesh, P()), "accuracy": NamedSharding(mesh, P())}
+    compiled = jax.jit(
+        step_fn,
+        in_shardings=(shd, data_shd, label_shd),
+        out_shardings=(shd, metric_shd),
+        donate_argnums=0,
+    )
+    return state, compiled
+
+
+def default_optimizer(lr: float = 1e-3, weight_decay: float = 1e-4) -> optax.GradientTransformation:
+    return optax.adamw(lr, weight_decay=weight_decay)
