@@ -1,0 +1,35 @@
+"""Clock abstraction: real time for deployment, simulated time for tests.
+
+The reference's timing behavior (1 s heartbeats, 3 s failure timeout, 3 s
+maintenance loops) was only ever validated by hand on live VMs (SURVEY.md §4).
+Every time-dependent component here takes a Clock so the simulator can drive
+whole failure/rejoin scenarios deterministically in milliseconds.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Clock:
+    def now(self) -> float:
+        return time.time()
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+
+class SimClock(Clock):
+    def __init__(self, start: float = 1_000_000.0):
+        self._t = start
+
+    def now(self) -> float:
+        return self._t
+
+    def monotonic(self) -> float:
+        return self._t
+
+    def advance(self, dt: float) -> None:
+        if dt < 0:
+            raise ValueError("time goes forward")
+        self._t += dt

@@ -1,0 +1,224 @@
+"""Gossip membership + failure detection.
+
+Capability parity with the reference's membership layer (src/membership.rs):
+
+- ring heartbeating: every round each node refreshes itself and pings its k=2
+  nearest ring neighbors on each side with its full membership list
+  (membership.rs:225-259, utils.rs:5-21)
+- failure detection: a neighbor silent for > failure_timeout is marked FAILED,
+  with a one-round grace period for newly-adjacent neighbors
+  (membership.rs:261-291)
+- anti-entropy merge: for a known id, newer last_active wins, ties prefer
+  FAILED; unknown ids are inserted (membership.rs:302-327)
+- join/welcome bootstrap with fast-rejoin: a joiner bumps its incarnation
+  timestamp; the introducer fails stale same-address entries so the new
+  incarnation supersedes them (membership.rs:113-123,185-214)
+
+Redesigned, not translated: the protocol core is sans-IO — a pure state
+machine advanced by ``step()`` with an injected Clock and Transport — so the
+deterministic simulator (tests/test_membership.py) can run crash / partition /
+rejoin scenarios hermetically, which the reference could only do by killing
+VMs by hand. In deployment a runner thread calls ``step()`` on the real clock
+(cluster/node.py); on a TPU fleet one membership node runs per TPU-VM host
+over DCN, and chips never appear here — devices are the mesh's concern
+(parallel/mesh.py), hosts are the cluster's.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, Iterable
+
+from dmlc_tpu.cluster.clock import Clock
+from dmlc_tpu.cluster.transport import Transport
+from dmlc_tpu.utils.config import ClusterConfig
+from dmlc_tpu.utils.ring import symmetric_ring_neighbors
+
+log = logging.getLogger(__name__)
+
+
+class Status(str, Enum):
+    ACTIVE = "active"
+    FAILED = "failed"
+    LEFT = "left"
+
+
+NodeId = tuple[str, float]  # (address, incarnation timestamp)
+
+
+@dataclass
+class Member:
+    status: Status
+    last_active: float
+
+    def to_wire(self) -> list:
+        return [self.status.value, self.last_active]
+
+    @classmethod
+    def from_wire(cls, w: list) -> "Member":
+        return cls(Status(w[0]), float(w[1]))
+
+
+def merge_entry(current: Member | None, incoming: Member) -> Member:
+    """Anti-entropy conflict resolution: newer last_active wins; on a tie the
+    FAILED/LEFT verdict sticks (so a failure can't be gossiped away by an
+    equally-old ACTIVE copy)."""
+    if current is None or incoming.last_active > current.last_active:
+        return incoming
+    if incoming.last_active == current.last_active and incoming.status != Status.ACTIVE:
+        return incoming
+    return current
+
+
+class MembershipNode:
+    """One node's view of the cluster. Drive with handle() for incoming
+    messages and step() once per heartbeat interval."""
+
+    def __init__(
+        self,
+        config: ClusterConfig,
+        transport: Transport,
+        clock: Clock,
+        on_change: Callable[[NodeId, Member], None] | None = None,
+    ):
+        self.config = config
+        self.transport = transport
+        self.clock = clock
+        self.on_change = on_change
+        self.self_id: NodeId = (transport.address, clock.now())
+        self.members: dict[NodeId, Member] = {
+            self.self_id: Member(Status.ACTIVE, clock.now())
+        }
+        self._prev_neighbors: set[NodeId] = set()
+        self._left = False
+        transport.set_handler(self.handle)
+
+    # ---- queries -------------------------------------------------------
+
+    def active_ids(self) -> list[NodeId]:
+        return sorted(i for i, m in self.members.items() if m.status == Status.ACTIVE)
+
+    def list_membership(self) -> list[tuple[NodeId, Member]]:
+        return sorted(self.members.items())
+
+    def is_active(self, node_id: NodeId) -> bool:
+        m = self.members.get(node_id)
+        return m is not None and m.status == Status.ACTIVE
+
+    # ---- lifecycle -----------------------------------------------------
+
+    def join(self, introducer: str) -> None:
+        """(Re)join via an introducer address. Bumps our incarnation so any
+        stale entry for our address is superseded cluster-wide."""
+        now = self.clock.now()
+        old = self.self_id
+        self.self_id = (self.transport.address, now)
+        self.members.pop(old, None)
+        self.members[self.self_id] = Member(Status.ACTIVE, now)
+        self._left = False
+        if introducer != self.transport.address:
+            self.transport.send(introducer, {"t": "join", "sender": list(self.self_id)})
+
+    def leave(self) -> None:
+        """Graceful exit: gossip a LEFT verdict so peers drop us without
+        waiting out the failure timeout."""
+        self._left = True
+        me = self.members[self.self_id]
+        me.status = Status.LEFT
+        me.last_active = self.clock.now()
+        for n in self._neighbors():
+            self._send_ping(n)
+
+    # ---- periodic step (pinger + detector) -----------------------------
+
+    def step(self) -> None:
+        if self._left:
+            return
+        now = self.clock.now()
+        self.members[self.self_id].last_active = now  # self-refresh
+        neighbors = self._neighbors()
+        for n in neighbors:
+            self._send_ping(n)
+        # Detector: only judge nodes that were already neighbors last round —
+        # a just-adopted neighbor gets one round to produce an ack.
+        cutoff = now - self.config.failure_timeout_s
+        for n in self._prev_neighbors & set(neighbors):
+            m = self.members.get(n)
+            if m is not None and m.status == Status.ACTIVE and m.last_active < cutoff:
+                self._set(n, Member(Status.FAILED, m.last_active))
+                log.warning("%s: detected failure of %s", self.transport.address, n)
+        self._prev_neighbors = set(neighbors)
+
+    def _neighbors(self) -> list[NodeId]:
+        return symmetric_ring_neighbors(
+            self.members.keys(),
+            self.self_id,
+            self.config.ring_k,
+            predicate=self.is_active,
+        )
+
+    def _send_ping(self, dest: NodeId) -> None:
+        self.transport.send(
+            dest[0], {"t": "ping", "sender": list(self.self_id), "list": self._wire_list()}
+        )
+
+    def _wire_list(self) -> list:
+        return [[i[0], i[1], *m.to_wire()] for i, m in self.members.items()]
+
+    # ---- message handling ---------------------------------------------
+
+    def handle(self, src: str, msg: dict) -> None:
+        if self._left:
+            return
+        kind = msg.get("t")
+        if kind == "ping":
+            self._merge_wire_list(msg["list"])
+            sender = tuple(msg["sender"])
+            self.transport.send(
+                sender[0],
+                {"t": "ack", "sender": list(self.self_id), "last_active": self.clock.now()},
+            )
+        elif kind == "ack":
+            sender = (msg["sender"][0], msg["sender"][1])
+            self._merge_one(sender, Member(Status.ACTIVE, float(msg["last_active"])))
+        elif kind == "join":
+            joiner = (msg["sender"][0], msg["sender"][1])
+            # Fast-rejoin: any older incarnation at the same address is dead.
+            for nid, m in list(self.members.items()):
+                if nid[0] == joiner[0] and nid[1] < joiner[1] and m.status == Status.ACTIVE:
+                    self._set(nid, Member(Status.FAILED, m.last_active))
+            self._merge_one(joiner, Member(Status.ACTIVE, self.clock.now()))
+            self.members[self.self_id].last_active = self.clock.now()
+            self.transport.send(
+                joiner[0], {"t": "welcome", "sender": list(self.self_id), "list": self._wire_list()}
+            )
+        elif kind == "welcome":
+            # Adopt the introducer's view wholesale (we know nothing yet).
+            self._merge_wire_list(msg["list"])
+
+    def _merge_wire_list(self, wire: list) -> None:
+        for addr, inc, status, last_active in wire:
+            self._merge_one((addr, float(inc)), Member.from_wire([status, last_active]))
+
+    def _merge_one(self, nid: NodeId, incoming: Member) -> None:
+        if nid == self.self_id:
+            # Nobody else's opinion of us beats our own liveness, except a
+            # FAILED verdict newer than our own refresh would be overwritten
+            # at the next step() anyway; keep self authoritative.
+            return
+        merged = merge_entry(self.members.get(nid), incoming)
+        self._set(nid, merged)
+
+    def _set(self, nid: NodeId, member: Member) -> None:
+        prev = self.members.get(nid)
+        self.members[nid] = member
+        if (prev is None or prev.status != member.status) and self.on_change is not None:
+            self.on_change(nid, member)
+        if prev is None:
+            log.info("%s: learned of %s (%s)", self.transport.address, nid, member.status.value)
+        elif prev.status != member.status:
+            log.info(
+                "%s: %s %s -> %s", self.transport.address, nid, prev.status.value, member.status.value
+            )

@@ -1,0 +1,203 @@
+"""Deterministic simulator tests for the gossip membership layer — the
+multi-node scenarios the reference could only exercise by killing live VMs
+(SURVEY.md §4): bootstrap, failure detection + propagation, fast rejoin,
+graceful leave, partitions.
+"""
+
+import pytest
+
+from dmlc_tpu.cluster.clock import SimClock
+from dmlc_tpu.cluster.membership import Member, MembershipNode, Status, merge_entry
+from dmlc_tpu.cluster.transport import SimNetwork
+from dmlc_tpu.utils.config import ClusterConfig
+
+
+class SimCluster:
+    """N membership nodes on an in-memory fabric with a shared fake clock."""
+
+    def __init__(self, n: int, ring_k: int = 2):
+        self.net = SimNetwork()
+        self.clock = SimClock()
+        self.config = ClusterConfig(ring_k=ring_k)
+        self.nodes: dict[str, MembershipNode] = {}
+        for i in range(n):
+            addr = f"node{i}:8850"
+            node = MembershipNode(self.config, self.net.endpoint(addr), self.clock)
+            self.nodes[addr] = node
+            self.clock.advance(0.001)  # distinct incarnations
+        # Everyone joins via node0.
+        for addr, node in self.nodes.items():
+            if addr != "node0:8850":
+                node.join("node0:8850")
+        self.net.deliver_all()
+
+    def round(self, dt: float = 1.0):
+        """One heartbeat round: advance time, step every live node, deliver."""
+        self.clock.advance(dt)
+        for addr, node in self.nodes.items():
+            if addr not in self.net.down:
+                node.step()
+        self.net.deliver_all()
+
+    def rounds(self, n: int, dt: float = 1.0):
+        for _ in range(n):
+            self.round(dt)
+
+    def statuses_seen_by(self, addr: str) -> dict[str, str]:
+        """address -> status of the *newest incarnation* known at `addr`."""
+        newest: dict[str, tuple[float, str]] = {}
+        for (a, inc), m in self.nodes[addr].members.items():
+            if a not in newest or inc > newest[a][0]:
+                newest[a] = (inc, m.status.value)
+        return {a: s for a, (_, s) in newest.items()}
+
+
+def test_merge_rules():
+    newer = Member(Status.ACTIVE, 10.0)
+    older = Member(Status.FAILED, 5.0)
+    assert merge_entry(older, newer) is newer           # newer last_active wins
+    assert merge_entry(newer, older) is newer
+    tie_failed = Member(Status.FAILED, 10.0)
+    assert merge_entry(newer, tie_failed) is tie_failed  # tie -> non-ACTIVE wins
+    assert merge_entry(tie_failed, Member(Status.ACTIVE, 10.0)) is tie_failed
+    assert merge_entry(None, older) is older             # unknown inserted
+
+
+def test_bootstrap_full_visibility():
+    c = SimCluster(5)
+    c.rounds(5)
+    for addr in c.nodes:
+        seen = c.statuses_seen_by(addr)
+        assert len(seen) == 5
+        assert all(s == "active" for s in seen.values()), (addr, seen)
+
+
+def test_failure_detection_and_propagation():
+    c = SimCluster(6)
+    c.rounds(5)
+    c.net.crash("node3:8850")
+    # Failure timeout is 3 s; within ~6 rounds everyone should know.
+    c.rounds(8)
+    for addr in c.nodes:
+        if addr == "node3:8850":
+            continue
+        assert c.statuses_seen_by(addr)["node3:8850"] == "failed", addr
+
+
+def test_detection_latency_bound():
+    # A crashed neighbor is detected within heartbeat + timeout + 2 rounds
+    # (mirrors the reference's ~1s heartbeat / 3s timeout envelope).
+    c = SimCluster(4)
+    c.rounds(5)
+    c.net.crash("node2:8850")
+    detected_at = None
+    for i in range(10):
+        c.round()
+        statuses = [
+            c.statuses_seen_by(a)["node2:8850"] for a in c.nodes if a != "node2:8850"
+        ]
+        if any(s == "failed" for s in statuses):
+            detected_at = i + 1
+            break
+    assert detected_at is not None and detected_at <= 5
+
+
+def test_fast_rejoin_new_incarnation():
+    c = SimCluster(5)
+    c.rounds(5)
+    c.net.crash("node4:8850")
+    c.rounds(8)
+    assert c.statuses_seen_by("node0:8850")["node4:8850"] == "failed"
+    # Restart: same address, new incarnation, joins via node1.
+    c.net.restart("node4:8850")
+    node = MembershipNode(c.config, c.net.endpoint("node4:8850"), c.clock)
+    c.nodes["node4:8850"] = node
+    node.join("node1:8850")
+    c.net.deliver_all()
+    c.rounds(6)
+    for addr in c.nodes:
+        assert c.statuses_seen_by(addr)["node4:8850"] == "active", addr
+    # The old incarnation is still remembered as failed at node0.
+    old_incs = [
+        m.status
+        for (a, _), m in c.nodes["node0:8850"].members.items()
+        if a == "node4:8850"
+    ]
+    assert Status.FAILED in old_incs and Status.ACTIVE in old_incs
+
+
+def test_graceful_leave_propagates():
+    c = SimCluster(5)
+    c.rounds(5)
+    c.nodes["node2:8850"].leave()
+    c.net.deliver_all()
+    c.rounds(4)
+    for addr in c.nodes:
+        if addr == "node2:8850":
+            continue
+        assert c.statuses_seen_by(addr)["node2:8850"] == "left", addr
+    # And a left node is not in anyone's active set.
+    for addr in c.nodes:
+        if addr == "node2:8850":
+            continue
+        actives = {i[0] for i in c.nodes[addr].active_ids()}
+        assert "node2:8850" not in actives
+
+
+def test_partition_detected_then_heals():
+    c = SimCluster(4, ring_k=2)
+    c.rounds(5)
+    victim = "node1:8850"
+    for other in c.nodes:
+        if other != victim:
+            c.net.partition(victim, other)
+    c.rounds(8)
+    for addr in c.nodes:
+        if addr != victim:
+            assert c.statuses_seen_by(addr)[victim] == "failed", addr
+    # Heal + rejoin brings it back under a fresh incarnation.
+    for other in c.nodes:
+        if other != victim:
+            c.net.heal(victim, other)
+    c.nodes[victim].join("node0:8850")
+    c.net.deliver_all()
+    c.rounds(6)
+    for addr in c.nodes:
+        assert c.statuses_seen_by(addr)[victim] == "active", addr
+
+
+def test_self_entry_authoritative():
+    c = SimCluster(3)
+    c.rounds(3)
+    n0 = c.nodes["node0:8850"]
+    # A peer gossiping a FAILED verdict about n0's own id must not stick.
+    n0.handle(
+        "node1:8850",
+        {
+            "t": "ping",
+            "sender": list(c.nodes["node1:8850"].self_id),
+            "list": [[n0.self_id[0], n0.self_id[1], "failed", c.clock.now() + 99]],
+        },
+    )
+    assert n0.members[n0.self_id].status == Status.ACTIVE
+
+
+def test_udp_transport_roundtrip():
+    """Real-socket smoke test for the deployment transport."""
+    import time
+
+    from dmlc_tpu.cluster.transport import UdpTransport
+
+    a = UdpTransport("127.0.0.1", 0)
+    b = UdpTransport("127.0.0.1", 0)
+    got = []
+    b.set_handler(lambda src, msg: got.append((src, msg)))
+    try:
+        a.send(b.address, {"t": "ping", "x": 1})
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert got and got[0][1]["t"] == "ping" and got[0][0] == a.address
+    finally:
+        a.close()
+        b.close()
