@@ -27,9 +27,10 @@ over DCN, and chips never appear here — devices are the mesh's concern
 from __future__ import annotations
 
 import logging
+import threading
 from dataclasses import dataclass, field
 from enum import Enum
-from typing import Callable, Iterable
+from typing import Callable
 
 from dmlc_tpu.cluster.clock import Clock
 from dmlc_tpu.cluster.transport import Transport
@@ -93,15 +94,21 @@ class MembershipNode:
         }
         self._prev_neighbors: set[NodeId] = set()
         self._left = False
+        # handle() runs on the transport's receiver thread while step() runs
+        # on the node's stepper thread; all state access goes through this
+        # lock (a no-op cost in the single-threaded simulator).
+        self._lock = threading.RLock()
         transport.set_handler(self.handle)
 
     # ---- queries -------------------------------------------------------
 
     def active_ids(self) -> list[NodeId]:
-        return sorted(i for i, m in self.members.items() if m.status == Status.ACTIVE)
+        with self._lock:
+            return sorted(i for i, m in self.members.items() if m.status == Status.ACTIVE)
 
     def list_membership(self) -> list[tuple[NodeId, Member]]:
-        return sorted(self.members.items())
+        with self._lock:
+            return sorted(self.members.items())
 
     def is_active(self, node_id: NodeId) -> bool:
         m = self.members.get(node_id)
@@ -112,44 +119,48 @@ class MembershipNode:
     def join(self, introducer: str) -> None:
         """(Re)join via an introducer address. Bumps our incarnation so any
         stale entry for our address is superseded cluster-wide."""
-        now = self.clock.now()
-        old = self.self_id
-        self.self_id = (self.transport.address, now)
-        self.members.pop(old, None)
-        self.members[self.self_id] = Member(Status.ACTIVE, now)
-        self._left = False
+        with self._lock:
+            now = self.clock.now()
+            old = self.self_id
+            self.self_id = (self.transport.address, now)
+            self.members.pop(old, None)
+            self.members[self.self_id] = Member(Status.ACTIVE, now)
+            self._left = False
         if introducer != self.transport.address:
             self.transport.send(introducer, {"t": "join", "sender": list(self.self_id)})
 
     def leave(self) -> None:
         """Graceful exit: gossip a LEFT verdict so peers drop us without
         waiting out the failure timeout."""
-        self._left = True
-        me = self.members[self.self_id]
-        me.status = Status.LEFT
-        me.last_active = self.clock.now()
-        for n in self._neighbors():
+        with self._lock:
+            self._left = True
+            me = self.members[self.self_id]
+            me.status = Status.LEFT
+            me.last_active = self.clock.now()
+            neighbors = self._neighbors()
+        for n in neighbors:
             self._send_ping(n)
 
     # ---- periodic step (pinger + detector) -----------------------------
 
     def step(self) -> None:
-        if self._left:
-            return
-        now = self.clock.now()
-        self.members[self.self_id].last_active = now  # self-refresh
-        neighbors = self._neighbors()
-        for n in neighbors:
-            self._send_ping(n)
-        # Detector: only judge nodes that were already neighbors last round —
-        # a just-adopted neighbor gets one round to produce an ack.
-        cutoff = now - self.config.failure_timeout_s
-        for n in self._prev_neighbors & set(neighbors):
-            m = self.members.get(n)
-            if m is not None and m.status == Status.ACTIVE and m.last_active < cutoff:
-                self._set(n, Member(Status.FAILED, m.last_active))
-                log.warning("%s: detected failure of %s", self.transport.address, n)
-        self._prev_neighbors = set(neighbors)
+        with self._lock:
+            if self._left:
+                return
+            now = self.clock.now()
+            self.members[self.self_id].last_active = now  # self-refresh
+            neighbors = self._neighbors()
+            for n in neighbors:
+                self._send_ping(n)
+            # Detector: only judge nodes that were already neighbors last round
+            # — a just-adopted neighbor gets one round to produce an ack.
+            cutoff = now - self.config.failure_timeout_s
+            for n in self._prev_neighbors & set(neighbors):
+                m = self.members.get(n)
+                if m is not None and m.status == Status.ACTIVE and m.last_active < cutoff:
+                    self._set(n, Member(Status.FAILED, m.last_active))
+                    log.warning("%s: detected failure of %s", self.transport.address, n)
+            self._prev_neighbors = set(neighbors)
 
     def _neighbors(self) -> list[NodeId]:
         return symmetric_ring_neighbors(
@@ -170,33 +181,41 @@ class MembershipNode:
     # ---- message handling ---------------------------------------------
 
     def handle(self, src: str, msg: dict) -> None:
-        if self._left:
-            return
-        kind = msg.get("t")
-        if kind == "ping":
-            self._merge_wire_list(msg["list"])
-            sender = tuple(msg["sender"])
-            self.transport.send(
-                sender[0],
-                {"t": "ack", "sender": list(self.self_id), "last_active": self.clock.now()},
-            )
-        elif kind == "ack":
-            sender = (msg["sender"][0], msg["sender"][1])
-            self._merge_one(sender, Member(Status.ACTIVE, float(msg["last_active"])))
-        elif kind == "join":
-            joiner = (msg["sender"][0], msg["sender"][1])
-            # Fast-rejoin: any older incarnation at the same address is dead.
-            for nid, m in list(self.members.items()):
-                if nid[0] == joiner[0] and nid[1] < joiner[1] and m.status == Status.ACTIVE:
-                    self._set(nid, Member(Status.FAILED, m.last_active))
-            self._merge_one(joiner, Member(Status.ACTIVE, self.clock.now()))
-            self.members[self.self_id].last_active = self.clock.now()
-            self.transport.send(
-                joiner[0], {"t": "welcome", "sender": list(self.self_id), "list": self._wire_list()}
-            )
-        elif kind == "welcome":
-            # Adopt the introducer's view wholesale (we know nothing yet).
-            self._merge_wire_list(msg["list"])
+        with self._lock:
+            if self._left:
+                return
+            kind = msg.get("t")
+            if kind == "ping":
+                self._merge_wire_list(msg["list"])
+                sender = tuple(msg["sender"])
+                self.transport.send(
+                    sender[0],
+                    {"t": "ack", "sender": list(self.self_id), "last_active": self.clock.now()},
+                )
+            elif kind == "ack":
+                sender = (msg["sender"][0], msg["sender"][1])
+                # Stamp with OUR receive time, not the remote clock: the
+                # detector compares last_active to the local clock, so using
+                # the sender's wall clock would turn clock skew > the failure
+                # timeout into a permanent false FAILED verdict.
+                self._merge_one(sender, Member(Status.ACTIVE, self.clock.now()))
+            elif kind == "join":
+                joiner = (msg["sender"][0], msg["sender"][1])
+                # Fast-rejoin: any older incarnation at the same address is
+                # dead. Stamp the verdict with now so it wins anti-entropy
+                # against peers holding a fresher ACTIVE for the stale id.
+                for nid, m in list(self.members.items()):
+                    if nid[0] == joiner[0] and nid[1] < joiner[1] and m.status == Status.ACTIVE:
+                        self._set(nid, Member(Status.FAILED, self.clock.now()))
+                self._merge_one(joiner, Member(Status.ACTIVE, self.clock.now()))
+                self.members[self.self_id].last_active = self.clock.now()
+                self.transport.send(
+                    joiner[0],
+                    {"t": "welcome", "sender": list(self.self_id), "list": self._wire_list()},
+                )
+            elif kind == "welcome":
+                # Adopt the introducer's view wholesale (we know nothing yet).
+                self._merge_wire_list(msg["list"])
 
     def _merge_wire_list(self, wire: list) -> None:
         for addr, inc, status, last_active in wire:
