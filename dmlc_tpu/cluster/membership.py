@@ -93,6 +93,11 @@ class MembershipNode:
             self.self_id: Member(Status.ACTIVE, clock.now())
         }
         self._prev_neighbors: set[NodeId] = set()
+        # Failure detection runs on LOCAL receipt times, never on gossiped
+        # remote-clock stamps: when we hear a node directly (ping or ack) we
+        # stamp our own clock here. Gossiped last_active orders anti-entropy
+        # merges only. This makes detection latency independent of clock skew.
+        self._last_heard: dict[NodeId, float] = {}
         self._left = False
         # handle() runs on the transport's receiver thread while step() runs
         # on the node's stepper thread; all state access goes through this
@@ -137,9 +142,8 @@ class MembershipNode:
             me = self.members[self.self_id]
             me.status = Status.LEFT
             me.last_active = self.clock.now()
-            neighbors = self._neighbors()
-        for n in neighbors:
-            self._send_ping(n)
+            for n in self._neighbors():
+                self._send_ping(n)  # under the lock: _wire_list iterates members
 
     # ---- periodic step (pinger + detector) -----------------------------
 
@@ -152,12 +156,18 @@ class MembershipNode:
             neighbors = self._neighbors()
             for n in neighbors:
                 self._send_ping(n)
-            # Detector: only judge nodes that were already neighbors last round
-            # — a just-adopted neighbor gets one round to produce an ack.
+                # A just-(re)adopted neighbor starts its silence clock now —
+                # one full timeout of grace before it can be judged (a stale
+                # stamp from a previous adjacency must not insta-fail it).
+                if n not in self._prev_neighbors:
+                    self._last_heard[n] = now
+            # Detector: only judge nodes that were already neighbors last
+            # round, and only on locally-stamped receipt times.
             cutoff = now - self.config.failure_timeout_s
             for n in self._prev_neighbors & set(neighbors):
                 m = self.members.get(n)
-                if m is not None and m.status == Status.ACTIVE and m.last_active < cutoff:
+                heard = self._last_heard.get(n, now)
+                if m is not None and m.status == Status.ACTIVE and heard < cutoff:
                     self._set(n, Member(Status.FAILED, m.last_active))
                     log.warning("%s: detected failure of %s", self.transport.address, n)
             self._prev_neighbors = set(neighbors)
@@ -186,18 +196,13 @@ class MembershipNode:
                 return
             kind = msg.get("t")
             if kind == "ping":
+                sender = (msg["sender"][0], msg["sender"][1])
+                self._last_heard[sender] = self.clock.now()  # direct evidence
                 self._merge_wire_list(msg["list"])
-                sender = tuple(msg["sender"])
-                self.transport.send(
-                    sender[0],
-                    {"t": "ack", "sender": list(self.self_id), "last_active": self.clock.now()},
-                )
+                self.transport.send(sender[0], {"t": "ack", "sender": list(self.self_id)})
             elif kind == "ack":
                 sender = (msg["sender"][0], msg["sender"][1])
-                # Stamp with OUR receive time, not the remote clock: the
-                # detector compares last_active to the local clock, so using
-                # the sender's wall clock would turn clock skew > the failure
-                # timeout into a permanent false FAILED verdict.
+                self._last_heard[sender] = self.clock.now()  # direct evidence
                 self._merge_one(sender, Member(Status.ACTIVE, self.clock.now()))
             elif kind == "join":
                 joiner = (msg["sender"][0], msg["sender"][1])

@@ -1,0 +1,212 @@
+"""Control-plane RPC: named methods over msgpack frames.
+
+The reference uses tarpc JSON-over-TCP for its Leader/Member services
+(src/services.rs:38-52,443-448; src/main.rs:43-83). Here the same capability
+is a small synchronous RPC layer with two fabrics:
+
+- ``SimRpcNetwork`` — deterministic in-process dispatch for the simulator:
+  scriptable crashes and partitions, no sockets, no threads. This is what the
+  hermetic cluster tests run on (the fake-transport strategy the reference
+  declared via its unused ``mockstream`` dev-dependency but never built,
+  SURVEY.md §4).
+- ``TcpRpcServer`` / ``tcp_call`` — real length-prefixed msgpack frames over
+  TCP for deployment, one connection per call (control traffic is tiny; bulk
+  tensor bytes never ride this path — they go host->HBM via the staging
+  pipeline, and device-to-device over ICI via XLA collectives).
+
+A "service" is just a dict of method-name -> callable(payload dict) -> reply
+dict. Method errors travel back as ``RpcError`` with the remote message.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+from typing import Callable
+
+import msgpack
+
+log = logging.getLogger(__name__)
+
+Method = Callable[[dict], dict]
+
+
+class RpcError(Exception):
+    """Transport failure or remote method failure."""
+
+
+class RpcUnreachable(RpcError):
+    """The destination did not answer (down, partitioned, refused)."""
+
+
+class Rpc:
+    """Client interface: synchronous call to a named method at an address."""
+
+    def call(self, addr: str, method: str, payload: dict, timeout: float = 60.0) -> dict:
+        raise NotImplementedError
+
+
+def _dispatch(methods: dict[str, Method], method: str, payload: dict) -> dict:
+    fn = methods.get(method)
+    if fn is None:
+        raise RpcError(f"unknown method {method!r}")
+    return fn(payload)
+
+
+class SimRpcNetwork(Rpc):
+    """Deterministic in-process RPC fabric.
+
+    Services register under string addresses; calls dispatch synchronously on
+    the caller's stack. Crashed or partitioned destinations raise
+    ``RpcUnreachable`` exactly like a dead TCP peer would.
+    """
+
+    def __init__(self):
+        self.services: dict[str, dict[str, Method]] = {}
+        self.down: set[str] = set()
+        self.cut: set[tuple[str, str]] = set()
+        self.calls: list[tuple[str, str]] = []  # (addr, method) trace for tests
+
+    def serve(self, addr: str, methods: dict[str, Method]) -> None:
+        self.services[addr] = methods
+
+    def crash(self, addr: str) -> None:
+        self.down.add(addr)
+
+    def restart(self, addr: str) -> None:
+        self.down.discard(addr)
+
+    def partition(self, a: str, b: str) -> None:
+        self.cut.add((a, b))
+        self.cut.add((b, a))
+
+    def heal(self, a: str, b: str) -> None:
+        self.cut.discard((a, b))
+        self.cut.discard((b, a))
+
+    def client(self, source: str) -> "SimRpcClient":
+        return SimRpcClient(self, source)
+
+    def _call_from(self, source: str, addr: str, method: str, payload: dict) -> dict:
+        self.calls.append((addr, method))
+        if source in self.down:
+            raise RpcUnreachable(f"{source} is down")
+        if addr in self.down or addr not in self.services or (source, addr) in self.cut:
+            raise RpcUnreachable(f"{addr} unreachable from {source}")
+        return _dispatch(self.services[addr], method, payload)
+
+
+class SimRpcClient(Rpc):
+    def __init__(self, network: SimRpcNetwork, source: str):
+        self.network = network
+        self.source = source
+
+    def call(self, addr: str, method: str, payload: dict, timeout: float = 60.0) -> dict:
+        return self.network._call_from(self.source, addr, method, payload)
+
+
+# ---------------------------------------------------------------------------
+# Real TCP fabric
+# ---------------------------------------------------------------------------
+
+_HDR = struct.Struct("!I")  # 4-byte big-endian frame length
+MAX_FRAME = 1 << 30  # 1 GiB — model weights fit; corrupt headers don't OOM us
+
+
+def _send_frame(sock: socket.socket, obj: dict) -> None:
+    data = msgpack.packb(obj, use_bin_type=True)
+    if len(data) > MAX_FRAME:
+        raise RpcError(f"frame of {len(data)} bytes exceeds MAX_FRAME")
+    sock.sendall(_HDR.pack(len(data)) + data)
+
+
+def _recv_frame(sock: socket.socket) -> dict:
+    hdr = _recv_exact(sock, _HDR.size)
+    (length,) = _HDR.unpack(hdr)
+    if length > MAX_FRAME:
+        raise RpcUnreachable(f"frame header claims {length} bytes (> MAX_FRAME)")
+    return msgpack.unpackb(bytes(_recv_exact(sock, length)), raw=False)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        read = sock.recv_into(view[got:], n - got)
+        if not read:
+            raise RpcUnreachable("connection closed mid-frame")
+        got += read
+    return buf
+
+
+class TcpRpcServer:
+    """Threaded TCP server hosting one method table."""
+
+    def __init__(self, host: str, port: int, methods: dict[str, Method]):
+        self.methods = methods
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((host, port))
+        self.sock.listen(64)
+        self.address = f"{host}:{self.sock.getsockname()[1]}"
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        self.sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            try:
+                while True:
+                    req = _recv_frame(conn)
+                    try:
+                        reply = _dispatch(self.methods, req["m"], req["p"])
+                        _send_frame(conn, {"ok": True, "r": reply})
+                    except Exception as e:  # method error -> remote RpcError
+                        _send_frame(conn, {"ok": False, "e": f"{type(e).__name__}: {e}"})
+            except (RpcUnreachable, OSError):
+                return  # client went away
+            except Exception:
+                # Malformed frame (bad msgpack, missing keys): drop the
+                # connection, never the server.
+                log.warning("closing connection after malformed frame", exc_info=True)
+                return
+
+    def close(self) -> None:
+        self._stop.set()
+        self.sock.close()
+        self._thread.join(timeout=1.0)
+
+
+class TcpRpc(Rpc):
+    """One connection per call. Control messages are small and infrequent
+    (heartbeats ride UDP, tensor bytes ride ICI/PCIe), so connection reuse
+    is not worth the failure-mode complexity here."""
+
+    def call(self, addr: str, method: str, payload: dict, timeout: float = 60.0) -> dict:
+        host, _, port = addr.rpartition(":")
+        try:
+            with socket.create_connection((host, int(port)), timeout=timeout) as sock:
+                sock.settimeout(timeout)
+                _send_frame(sock, {"m": method, "p": payload})
+                reply = _recv_frame(sock)
+        except RpcUnreachable:
+            raise
+        except (OSError, ValueError) as e:
+            raise RpcUnreachable(f"{addr}: {e}") from e
+        if not reply.get("ok"):
+            raise RpcError(reply.get("e", "remote error"))
+        return reply["r"]
