@@ -1,0 +1,221 @@
+"""Interactive CLI / REPL — the reference's full command surface.
+
+Capability parity with src/main.rs:85-338 and README.md:10-23: membership
+verbs (list_mem/lm, list_self, join/j, leave/l), SDFS verbs (put/p, get/g,
+delete/d, ls, store/s, get-versions/gv), ML verbs (train/t, predict, jobs,
+assign), plus help/exit. ``jobs`` prints accuracy and latency percentiles
+(mean/std/median/p90/p95/p99) exactly like the reference's histogram report
+(main.rs:282-309). Logs go to ``{HOSTNAME}.log`` (main.rs:27-28).
+
+Run: ``python -m dmlc_tpu.cli --config cluster.json`` (or with no config for
+a single-node local cluster).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import shlex
+import socket
+import sys
+
+from dmlc_tpu.utils.config import ClusterConfig
+
+
+def format_table(headers: list[str], rows: list[list]) -> str:
+    """Plain aligned-column table (the reference used the `tabled` crate)."""
+    cells = [[str(c) for c in row] for row in rows]
+    widths = [
+        max(len(h), *(len(r[i]) for r in cells)) if cells else len(h)
+        for i, h in enumerate(headers)
+    ]
+    def line(row):
+        return " | ".join(c.ljust(w) for c, w in zip(row, widths))
+    sep = "-+-".join("-" * w for w in widths)
+    return "\n".join([line(headers), sep, *(line(r) for r in cells)])
+
+
+def format_latency(summary: dict[str, float]) -> str:
+    ms = lambda k: f"{summary[k] * 1e3:.2f}ms" if summary.get("count") else "-"
+    return (
+        f"n={int(summary.get('count', 0))} mean={ms('mean')} std={ms('std')} "
+        f"median={ms('median')} p90={ms('p90')} p95={ms('p95')} p99={ms('p99')}"
+    )
+
+
+HELP = """\
+Commands (reference: README.md:10-23):
+  list_mem | lm                         list active members
+  list_self                             print this node's id
+  join | j <host:gossip_port>           join the cluster via an introducer
+  leave | l                             leave the cluster
+  put | p <local_path> <sdfs_name>      store a file (new version)
+  get | g <sdfs_name> <local_path>      fetch latest version
+  get-versions | gv <name> <n> <local>  fetch last n versions, merged
+  delete | d <sdfs_name>                delete all versions
+  ls [<sdfs_name>]                      where files live (leader directory)
+  store | s                             files stored on this node
+  train | t                             broadcast model weights to members
+  predict                               start/resume the inference jobs
+  jobs                                  job status, accuracy, latency percentiles
+  assign                                per-job member assignment table
+  help                                  this text
+  exit | quit                           leave and stop the node
+"""
+
+
+class Cli:
+    """Command dispatcher over a running ClusterNode. Returns output strings
+    so tests can drive it without capturing stdout."""
+
+    def __init__(self, node):
+        self.node = node
+
+    def run_command(self, line: str) -> str:
+        try:
+            parts = shlex.split(line)
+        except ValueError as e:
+            return f"parse error: {e}"
+        if not parts:
+            return ""
+        cmd, *args = parts
+        try:
+            return self._dispatch(cmd, args)
+        except EOFError:
+            raise  # exit/quit propagates to the REPL
+        except Exception as e:  # RPC errors, bad paths — report, don't crash
+            return f"error: {type(e).__name__}: {e}"
+
+    def _dispatch(self, cmd: str, args: list[str]) -> str:
+        n = self.node
+        if cmd in ("list_mem", "lm"):
+            rows = [
+                [addr, f"{inc:.3f}", m.status.value]
+                for (addr, inc), m in n.membership.list_membership()
+                if m.status.value == "active"
+            ]
+            return format_table(["address", "incarnation", "status"], rows)
+        if cmd == "list_self":
+            addr, inc = n.membership.self_id
+            return f"{addr} (incarnation {inc:.3f})"
+        if cmd in ("join", "j"):
+            if len(args) != 1:
+                return "usage: join <host:gossip_port>"
+            n.join(args[0])
+            return f"join sent to {args[0]}"
+        if cmd in ("leave", "l"):
+            n.leave()
+            return "left the cluster"
+        if cmd in ("put", "p"):
+            if len(args) != 2:
+                return "usage: put <local_path> <sdfs_name>"
+            reply = n.sdfs.put(args[0], args[1])
+            return format_table(
+                ["name", "version", "replicas"],
+                [[args[1], reply["version"], ", ".join(reply["replicas"])]],
+            )
+        if cmd in ("get", "g"):
+            if len(args) != 2:
+                return "usage: get <sdfs_name> <local_path>"
+            version = n.sdfs.get(args[0], args[1])
+            return f"fetched {args[0]} v{version} -> {args[1]}"
+        if cmd in ("get-versions", "gv"):
+            if len(args) != 3:
+                return "usage: get-versions <sdfs_name> <n> <local_path>"
+            versions = n.sdfs.get_versions(args[0], int(args[1]), args[2])
+            return f"fetched versions {versions} of {args[0]} -> {args[2]}"
+        if cmd in ("delete", "d"):
+            if len(args) != 1:
+                return "usage: delete <sdfs_name>"
+            reply = n.sdfs.delete(args[0])
+            return f"deleted from: {', '.join(reply['deleted_from']) or '(nowhere)'}"
+        if cmd == "ls":
+            files = n.sdfs.ls(args[0] if args else None)
+            rows = [
+                [name, member, ", ".join(f"v{v}" for v in sorted(vs))]
+                for name, members in sorted(files.items())
+                for member, vs in sorted(members.items())
+            ]
+            return format_table(["name", "member", "versions"], rows)
+        if cmd in ("store", "s"):
+            rows = [
+                [name, ", ".join(f"v{v}" for v in vs)]
+                for name, vs in sorted(n.store.listing().items())
+            ]
+            return format_table(["name", "versions"], rows)
+        if cmd in ("train", "t"):
+            results = n.train()
+            rows = [[name, len(ms)] for name, ms in sorted(results.items())]
+            return format_table(["weights file", "members updated"], rows)
+        if cmd == "predict":
+            reply = n.predict()
+            return f"started jobs: {', '.join(reply['jobs'])}"
+        if cmd == "jobs":
+            out = []
+            for name, r in sorted(n.jobs_report().items()):
+                out.append(
+                    f"{name}: {'RUNNING' if r['running'] else 'idle'} "
+                    f"{r['finished']}/{r['total']} finished, "
+                    f"accuracy {r['accuracy'] * 100:.2f}% "
+                    f"({r['correct']}/{r['finished'] or 1})"
+                )
+                out.append(f"  query latency: {format_latency(r['query_latency'])}")
+                out.append(f"  shard latency: {format_latency(r['shard_latency'])}")
+            return "\n".join(out) or "no jobs"
+        if cmd == "assign":
+            rows = [
+                [job, len(members), ", ".join(members)]
+                for job, members in sorted(n.assignments().items())
+            ]
+            return format_table(["job", "#members", "members"], rows)
+        if cmd == "help":
+            return HELP
+        if cmd in ("exit", "quit"):
+            raise EOFError
+        return f"unknown command {cmd!r} (try: help)"
+
+
+def repl(node) -> None:
+    cli = Cli(node)
+    while True:
+        try:
+            line = input("> ")
+        except (EOFError, KeyboardInterrupt):
+            break
+        try:
+            out = cli.run_command(line)
+        except EOFError:
+            break
+        if out:
+            print(out)
+    node.leave()
+    node.stop()
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="dmlc_tpu cluster node")
+    parser.add_argument("--config", help="path to a ClusterConfig JSON file")
+    parser.add_argument("--log-file", help="override the {HOSTNAME}.log default")
+    args = parser.parse_args(argv)
+
+    config = ClusterConfig.from_json(args.config) if args.config else ClusterConfig()
+    log_file = args.log_file or f"{socket.gethostname()}.log"
+    logging.basicConfig(
+        filename=log_file,
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
+    from dmlc_tpu.cluster.node import ClusterNode
+
+    node = ClusterNode(config)
+    node.start()
+    print(f"node up: member={node.self_member_addr} gossip={node.gossip.address}")
+    if node.is_candidate:
+        print(f"leader candidate at {node.self_leader_addr}")
+    print("type 'help' for commands")
+    repl(node)
+
+
+if __name__ == "__main__":
+    main()

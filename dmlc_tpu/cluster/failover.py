@@ -1,0 +1,137 @@
+"""Leader failover: candidate tracking, liveness probing, standby state sync.
+
+Capability parity with the reference's failover machinery:
+
+- a configured ordered list of leader candidates (was the hardcoded
+  ``LEADER_HOSTNAMES``, src/services.rs:26-30 — here it's config data)
+- member-side probe loop: call ``leader.alive`` every probe interval; on
+  failure advance to the next candidate, wrapping (services.rs:527-545,
+  575-580)
+- standby-leader loop: while not current leader, copy job state from the
+  current leader; on becoming leader with nonempty history, auto-resume
+  the prediction jobs (services.rs:212-240)
+
+Together with the scheduler's resume-from-cursor this gives the reference's
+headline behavior: "the new leader will try to pick up where it left off"
+(CS425MP4Report), detectable within one probe interval.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+from dmlc_tpu.cluster.rpc import Rpc, RpcError, RpcUnreachable
+
+log = logging.getLogger(__name__)
+
+
+class LeaderTracker:
+    """Which candidate do I currently believe is leader? Probe and advance."""
+
+    def __init__(self, rpc: Rpc, candidates: list[str]):
+        if not candidates:
+            raise ValueError("need at least one leader candidate")
+        self.rpc = rpc
+        self.candidates = list(candidates)
+        self.index = 0
+
+    @property
+    def current(self) -> str:
+        return self.candidates[self.index]
+
+    def probe(self, timeout: float = 2.0) -> bool:
+        """One liveness check; advances to the next candidate on failure.
+        Returns True if the current (possibly just-advanced-to) leader
+        answered."""
+        try:
+            self.rpc.call(self.current, "leader.alive", {}, timeout=timeout)
+            return True
+        except (RpcUnreachable, RpcError):
+            prev = self.current
+            self.index = (self.index + 1) % len(self.candidates)
+            log.warning("leader %s unresponsive; trying %s", prev, self.current)
+            return False
+
+
+class StandbyLeader:
+    """A leader candidate that is not (yet) the active leader.
+
+    ``step()`` implements one pass of the reference's 3 s monitor loop
+    (services.rs:212-240), with one correction to the reference's design:
+    leadership is *claimed and observed*, not implied by list position. A
+    candidate promotes only when no candidate anywhere answers
+    ``leader.status`` with ``leading: true`` AND every candidate ahead of it
+    is dead — so a rebooted ex-leader defers to whoever promoted in its
+    absence instead of creating a second active leader. While another
+    candidate leads, we mirror its job state AND its SDFS directory (the
+    reference replicated only job state; losing the directory on failover
+    would orphan every stored file and recycle version numbers).
+
+    Like the reference's static-candidate scheme, this is liveness-based,
+    not a consensus protocol: a full network partition between candidates
+    can still yield two claimants until the partition heals.
+    """
+
+    def __init__(
+        self,
+        rpc: Rpc,
+        self_addr: str,
+        candidates: list[str],
+        scheduler,
+        sdfs_leader=None,
+        on_promote: Callable[[], None] | None = None,
+    ):
+        self.rpc = rpc
+        self.self_addr = self_addr
+        self.candidates = list(candidates)
+        self.scheduler = scheduler
+        self.sdfs_leader = sdfs_leader
+        self.on_promote = on_promote
+        self.is_leader = False
+
+    def step(self) -> None:
+        if self.is_leader:
+            return
+        leading = None
+        alive: set[str] = set()
+        for addr in self.candidates:
+            if addr == self.self_addr:
+                continue
+            try:
+                status = self.rpc.call(addr, "leader.status", {}, timeout=2.0)
+            except (RpcUnreachable, RpcError):
+                continue
+            alive.add(addr)
+            if status.get("leading"):
+                leading = addr
+                break
+        if leading is not None:
+            self._sync_from(leading)
+            return
+        # Nobody claims leadership: the first live candidate takes over.
+        for addr in self.candidates:
+            if addr == self.self_addr:
+                self._promote()
+                return
+            if addr in alive:
+                return  # a live candidate ahead of us will promote
+
+    def _sync_from(self, addr: str) -> None:
+        try:
+            self.scheduler.adopt_state(self.rpc.call(addr, "job.state", {}, timeout=2.0))
+            if self.sdfs_leader is not None:
+                wire = self.rpc.call(addr, "sdfs.state", {}, timeout=2.0)
+                self.sdfs_leader.adopt_state(wire)
+        except (RpcUnreachable, RpcError) as e:
+            log.warning("standby sync from %s failed: %s", addr, e)
+
+    def _promote(self) -> None:
+        self.is_leader = True
+        self.scheduler.is_leading = True
+        log.warning("%s: promoting to leader", self.self_addr)
+        if self.scheduler.has_history():
+            # Resume interrupted jobs from the replicated cursor.
+            self.scheduler._start({})
+        if self.on_promote is not None:
+            self.on_promote()

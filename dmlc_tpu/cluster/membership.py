@@ -131,6 +131,11 @@ class MembershipNode:
             self.members.pop(old, None)
             self.members[self.self_id] = Member(Status.ACTIVE, now)
             self._left = False
+            # A fresh incarnation starts with a clean detector: stale
+            # neighbor stamps from the previous life must not insta-fail
+            # nodes that were silent only because we were gone.
+            self._prev_neighbors = set()
+            self._last_heard = {}
         if introducer != self.transport.address:
             self.transport.send(introducer, {"t": "join", "sender": list(self.self_id)})
 

@@ -1,0 +1,270 @@
+"""Process bootstrap: one ClusterNode wires every layer for deployment.
+
+Capability parity with the reference's main() (src/main.rs:25-41): start
+membership threads, start the member RPC server, conditionally start the
+leader server (if this host is a leader candidate), and hand a handle to the
+CLI. Periodic maintenance loops mirror the reference's tokio tasks:
+
+- membership step every heartbeat interval (membership.rs:225-291)
+- SDFS healing every rereplication interval (services.rs:186-198)
+- job assignment every assignment interval (services.rs:199-211)
+- dispatch loop feeding shards to members (services.rs:407-433)
+- member-side leader probe (services.rs:527-545)
+- standby-leader state sync (services.rs:212-240)
+
+Addressing convention: a node's identity is its gossip address
+``host:gossip_port``; its RPC server lives at ``host:member_port`` (and
+``host:leader_port`` when leading). ``member_rpc_addr`` maps between them,
+so membership stays the single source of liveness truth.
+
+On a TPU fleet one ClusterNode runs per TPU-VM host; its worker backends
+drive the host's chips through the mesh (parallel/mesh.py). Models load
+eagerly at startup like the reference (services.rs:513-524) unless
+``lazy_models`` is set.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from pathlib import Path
+
+from dmlc_tpu.cluster.clock import Clock
+from dmlc_tpu.cluster.failover import LeaderTracker, StandbyLeader
+from dmlc_tpu.cluster.membership import MembershipNode
+from dmlc_tpu.cluster.rpc import TcpRpc, TcpRpcServer
+from dmlc_tpu.cluster.sdfs import MemberStore, SdfsClient, SdfsLeader, SdfsMember
+from dmlc_tpu.cluster.transport import UdpTransport
+from dmlc_tpu.scheduler.jobs import JobScheduler
+from dmlc_tpu.scheduler.worker import EngineBackend, PredictWorker
+from dmlc_tpu.utils.config import ClusterConfig
+
+log = logging.getLogger(__name__)
+
+
+def member_rpc_addr(gossip_addr: str, port_offset: int) -> str:
+    """Map a gossip identity to its member RPC address. The fleet shares one
+    port layout (the reference's fixed 8850/8851/8852 scheme,
+    membership.rs:64 + services.rs:31-32); here it's the *offset* that is
+    fleet-wide, so several nodes can share a host in tests."""
+    host, _, gport = gossip_addr.rpartition(":")
+    return f"{host}:{int(gport) + port_offset}"
+
+
+class ClusterNode:
+    """One running node: membership + member services + optional leadership."""
+
+    def __init__(self, config: ClusterConfig, backends: dict | None = None):
+        self.config = config
+        self.clock = Clock()
+        self.rpc = TcpRpc()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+        # --- L1 membership over UDP gossip -----------------------------
+        self.gossip = UdpTransport(config.host, config.gossip_port)
+        self.membership = MembershipNode(config, self.gossip, self.clock)
+
+        # --- member services (SDFS store + inference worker) -----------
+        self.store = MemberStore(Path(config.storage_dir))
+        self.sdfs_member = SdfsMember(self.store, self.rpc)
+        if backends is None:
+            backends = {
+                name: EngineBackend(name, config.data_dir, batch_size=config.batch_size)
+                for name in config.job_models
+            }
+        self.worker = PredictWorker(backends)
+        methods = {**self.sdfs_member.methods(), **self.worker.methods()}
+        self.member_server = TcpRpcServer(config.host, config.member_port, methods)
+        self.self_member_addr = self.member_server.address
+
+        # --- leader-candidate machinery --------------------------------
+        candidates = config.leader_candidates or [f"{config.host}:{config.leader_port}"]
+        self.leader_candidates = list(candidates)
+        self.self_leader_addr = f"{config.host}:{config.leader_port}"
+        self.is_candidate = self.self_leader_addr in self.leader_candidates
+        self.tracker = LeaderTracker(self.rpc, self.leader_candidates)
+
+        self.leader_server = None
+        self.sdfs_leader = None
+        self.scheduler = None
+        self.standby = None
+        if self.is_candidate:
+            self._start_leader_services()
+
+        self.sdfs = SdfsClient(
+            self.rpc, self.tracker.current, self.store, self.self_member_addr
+        )
+
+    # ---- leader side ---------------------------------------------------
+
+    def _load_workload(self) -> list[tuple[str, int]]:
+        from dmlc_tpu.ops.preprocess import load_synset_words
+
+        path = Path(self.config.synset_path)
+        if not path.exists():
+            return []
+        return [(synset, i) for i, (synset, _) in enumerate(load_synset_words(path))]
+
+    def _start_leader_services(self) -> None:
+        workload = self._load_workload()
+        self.sdfs_leader = SdfsLeader(
+            self.rpc, self.active_member_addrs, self.config.replication_factor
+        )
+        self.scheduler = JobScheduler(
+            self.rpc,
+            self.active_member_addrs,
+            jobs={name: list(workload) for name in self.config.job_models},
+            shard_size=self.config.dispatch_shard_size,
+        )
+        methods = {**self.sdfs_leader.methods(), **self.scheduler.methods()}
+        self.leader_server = TcpRpcServer(self.config.host, self.config.leader_port, methods)
+        # Leadership is claimed via StandbyLeader.step(), never assumed at
+        # boot: a restarted ex-leader must defer to whoever promoted while
+        # it was down instead of double-leading.
+        self.standby = StandbyLeader(
+            self.rpc,
+            self.self_leader_addr,
+            self.leader_candidates,
+            self.scheduler,
+            sdfs_leader=self.sdfs_leader,
+        )
+
+    # ---- liveness glue -------------------------------------------------
+
+    def active_member_addrs(self) -> list[str]:
+        offset = self.config.member_port - self.config.gossip_port
+        return [
+            member_rpc_addr(addr, offset) for addr, _ in self.membership.active_ids()
+        ]
+
+    # ---- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        """Spawn the periodic loops (the reference's tokio tasks). Model
+        engines warm up first — compile-time GIL holds must not starve the
+        heartbeat threads into a false FAILED verdict."""
+        if self.config.eager_load:
+            for backend in self.worker.backends.values():
+                if hasattr(backend, "warmup"):
+                    backend.warmup()
+        self._spawn(self._membership_loop)
+        self._spawn(self._probe_loop)
+        if self.is_candidate:
+            self._spawn(self._heal_loop)
+            self._spawn(self._assign_loop)
+            self._spawn(self._dispatch_loop)
+            self._spawn(self._standby_loop)
+
+    def _spawn(self, fn) -> None:
+        t = threading.Thread(target=fn, daemon=True, name=fn.__name__)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self.member_server.close()
+        if self.leader_server is not None:
+            self.leader_server.close()
+        self.gossip.close()
+
+    def _loop(self, interval: float, body) -> None:
+        while not self._stop.is_set():
+            try:
+                body()
+            except Exception:
+                log.exception("maintenance loop error")
+            self._stop.wait(interval)
+
+    def _membership_loop(self):
+        self._loop(self.config.heartbeat_interval_s, self.membership.step)
+
+    def _probe_loop(self):
+        def body():
+            self.tracker.probe()
+            self.sdfs.leader_addr = self.tracker.current
+
+        self._loop(self.config.leader_probe_interval_s, body)
+
+    def _heal_loop(self):
+        self._loop(
+            self.config.rereplication_interval_s,
+            lambda: self._if_leading(lambda: self.sdfs_leader.heal_once()),
+        )
+
+    def _assign_loop(self):
+        self._loop(
+            self.config.assignment_interval_s,
+            lambda: self._if_leading(self.scheduler.assign_once),
+        )
+
+    def _dispatch_loop(self):
+        def body():
+            if self.standby.is_leader and self.scheduler.dispatch_all_once() > 0:
+                return  # more work queued: loop immediately, no sleep
+            self._stop.wait(0.05)
+
+        while not self._stop.is_set():
+            try:
+                body()
+            except Exception:
+                log.exception("dispatch loop error")
+
+    def _standby_loop(self):
+        self._loop(self.config.leader_probe_interval_s, self.standby.step)
+
+    def _if_leading(self, fn):
+        if self.standby is not None and self.standby.is_leader:
+            fn()
+
+    # ---- CLI-facing verbs ---------------------------------------------
+
+    def join(self, introducer_gossip_addr: str) -> None:
+        self.membership.join(introducer_gossip_addr)
+
+    def leave(self) -> None:
+        self.membership.leave()
+
+    def train(self) -> dict:
+        """The reference's `train`: broadcast model weights to every member
+        through SDFS (services.rs:139-144) — each member pulls the latest
+        weights file for each job model."""
+        results = {}
+        for name in self.config.job_models:
+            sdfs_name = f"models/{name}"
+            pulled = []
+            try:
+                info = self.rpc.call(self.tracker.current, "sdfs.get", {"name": sdfs_name})
+            except Exception as e:
+                log.warning("train: no weights for %s: %s", sdfs_name, e)
+                results[sdfs_name] = pulled
+                continue
+            for member in self.active_member_addrs():
+                try:
+                    self.rpc.call(
+                        member,
+                        "sdfs.replicate",
+                        {
+                            "name": sdfs_name,
+                            "version": info["version"],
+                            "source": info["replicas"][0],
+                            "from_stage": False,
+                        },
+                    )
+                    pulled.append(member)
+                except Exception as e:
+                    log.warning("train: %s -> %s: %s", sdfs_name, member, e)
+            results[sdfs_name] = pulled
+        return results
+
+    def predict(self) -> dict:
+        return self.rpc.call(self.tracker.current, "job.start", {})
+
+    def jobs_report(self) -> dict:
+        return self.rpc.call(self.tracker.current, "job.report", {})["jobs"]
+
+    def assignments(self) -> dict:
+        return self.rpc.call(self.tracker.current, "job.assignments", {})["assigned"]

@@ -44,7 +44,11 @@ def sanitize(name: str) -> str:
 
 
 def storage_filename(name: str, version: int) -> str:
-    return f"v{version}.{sanitize(name)}"
+    """On-disk name: readable sanitized form + a short digest of the RAW
+    name, so distinct SDFS names that sanitize identically ('a/b' vs 'a_b')
+    never collide on a shared replica."""
+    digest = hashlib.sha256(name.encode()).hexdigest()[:10]
+    return f"v{version}.{digest}.{sanitize(name)}"
 
 
 def placement_order(name: str, candidates: list[str]) -> list[str]:
@@ -213,7 +217,19 @@ class SdfsLeader:
             "sdfs.get_versions": self._get_versions,
             "sdfs.delete": self._delete,
             "sdfs.ls": self._ls,
+            "sdfs.state": self._state_wire,
         }
+
+    def _state_wire(self, p: dict) -> dict:
+        """Directory replication payload for standby leaders — without it a
+        failover would orphan every stored file and recycle versions."""
+        with self._lock:
+            return {"directory": self.state.to_wire()}
+
+    def adopt_state(self, wire: dict) -> None:
+        """Standby sync: mirror the active leader's directory wholesale."""
+        with self._lock:
+            self.state = SdfsLeaderState.from_wire(wire["directory"])
 
     # ---- RPC methods ---------------------------------------------------
 
@@ -271,7 +287,8 @@ class SdfsLeader:
     def _ls(self, p: dict) -> dict:
         with self._lock:
             if name := p.get("name"):
-                return {"files": {name: self.state.to_wire().get(name, {})}}
+                entry = self.state.directory.get(name, {})
+                return {"files": {name: {m: sorted(vs) for m, vs in entry.items()}}}
             return {"files": self.state.to_wire()}
 
     # ---- placement + healing -------------------------------------------

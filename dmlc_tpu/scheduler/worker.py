@@ -1,0 +1,95 @@
+"""Member-side inference worker: answers ``job.predict`` shards.
+
+Capability parity with the reference's member predict path
+(src/services.rs:475-497): given a model name and a list of synset ids, look
+up one fixture image per synset, preprocess, forward, return top-1 — except
+the unit here is a shard (one batched XLA execution for the whole list), not
+one image under a model mutex.
+
+The model backend is injectable: the real node wires ``EngineBackend``
+(InferenceEngine on the TPU mesh, models loaded eagerly at startup like
+services.rs:513-524); hermetic cluster tests wire a fake backend so scheduler
+logic is testable with no JAX at all.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from pathlib import Path
+from typing import Callable, Protocol, Sequence
+
+from dmlc_tpu.cluster.rpc import RpcError
+
+log = logging.getLogger(__name__)
+
+# (synset_ids) -> list of predicted class indices
+PredictFn = Callable[[Sequence[str]], list[int]]
+
+
+class PredictWorker:
+    """RPC surface for shard prediction over a registry of models."""
+
+    def __init__(self, backends: dict[str, PredictFn]):
+        self.backends = dict(backends)
+
+    def methods(self) -> dict:
+        return {"job.predict": self._predict}
+
+    def _predict(self, p: dict) -> dict:
+        model, synsets = p["model"], list(p["synsets"])
+        fn = self.backends.get(model)
+        if fn is None:
+            raise RpcError(f"model {model!r} not loaded here; have {sorted(self.backends)}")
+        preds = fn(synsets)
+        if len(preds) != len(synsets):
+            raise RpcError(f"backend returned {len(preds)} predictions for {len(synsets)} queries")
+        return {"predictions": [int(x) for x in preds]}
+
+
+class EngineBackend:
+    """Real backend: fixture images through an InferenceEngine.
+
+    Loads lazily on first shard (JAX import + compile are heavy; tests that
+    never dispatch to a real model shouldn't pay), then serves every shard
+    with one batched device execution. A lock serializes shards per engine —
+    the device pipeline is already saturated by one batch stream; the
+    reference serialized with a model mutex too (services.rs:493).
+    """
+
+    def __init__(self, model_name: str, data_dir: str | Path, batch_size: int = 256):
+        self.model_name = model_name
+        self.data_dir = Path(data_dir)
+        self.batch_size = batch_size
+        self._engine = None
+        self._lock = threading.Lock()
+
+    def warmup(self) -> None:
+        """Build + compile the engine now. Call at node startup, BEFORE the
+        membership loops begin: tracing/compiling holds the GIL for seconds
+        at a time, which starves the heartbeat threads past the failure
+        timeout and gets the node falsely marked FAILED mid-compile (the
+        reference loads models eagerly before joining for the same reason,
+        services.rs:513-524)."""
+        with self._lock:
+            self._ensure_engine()
+
+    def _ensure_engine(self):
+        if self._engine is None:
+            from dmlc_tpu.parallel.inference import InferenceEngine
+
+            self._engine = InferenceEngine(self.model_name, batch_size=self.batch_size)
+            self._engine.warmup()
+        return self._engine
+
+    def __call__(self, synsets: Sequence[str]) -> list[int]:
+        from dmlc_tpu.ops import preprocess as pp
+
+        with self._lock:
+            engine = self._ensure_engine()
+            paths = [pp.class_image_path(self.data_dir, s) for s in synsets]
+            preds: list[int] = []
+            for i in range(0, len(paths), self.batch_size):
+                result = engine.run_paths(paths[i : i + self.batch_size])
+                preds.extend(int(x) for x in result.top1_index)
+            return preds

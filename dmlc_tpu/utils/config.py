@@ -52,6 +52,13 @@ class ClusterConfig:
     model_dtype: str = "bfloat16"
     data_dir: str = "test_files/imagenet_1k/train"
     synset_path: str = "synset_words.txt"
+    # The reference's two static jobs (src/services.rs:168-169); any registry
+    # model name works here.
+    job_models: list[str] = field(default_factory=lambda: ["resnet18", "alexnet"])
+    # Compile engines at node startup, before membership begins (the
+    # reference's eager model load, src/services.rs:513-524). Lazy loading
+    # risks compile-time GIL holds starving the heartbeat threads.
+    eager_load: bool = True
 
     def with_updates(self, **kw) -> "ClusterConfig":
         return dataclasses.replace(self, **kw)

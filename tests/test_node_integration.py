@@ -1,0 +1,166 @@
+"""End-to-end: a real 3-node cluster on localhost (UDP gossip, TCP RPC,
+maintenance threads), driven through the CLI command surface — the whole
+stack the reference only ever exercised by hand on 10 VMs.
+
+Fake inference backends keep this hermetic (no JAX); the real EngineBackend
+path is covered by bench.py on hardware.
+"""
+
+import random
+import time
+
+import pytest
+
+from dmlc_tpu.cli import Cli
+from dmlc_tpu.cluster.node import ClusterNode
+from dmlc_tpu.utils.config import ClusterConfig
+
+
+def wait_until(cond, timeout=15.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def make_synsets(tmp_path, n=40):
+    path = tmp_path / "synsets.txt"
+    path.write_text("".join(f"n{i:08d} label {i}\n" for i in range(n)))
+    return path
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    """3 nodes on 127.0.0.1 with the fleet port layout (offsets 0/+1/+2)."""
+    base = random.randint(21000, 52000) // 10 * 10
+    synset_path = make_synsets(tmp_path)
+    nodes = []
+    leader_candidates = [f"127.0.0.1:{base + 1}", f"127.0.0.1:{base + 11}"]
+
+    def fake_backend(synsets):
+        return [int(s[1:]) for s in synsets]  # always right
+
+    for i in range(3):
+        cfg = ClusterConfig(
+            host="127.0.0.1",
+            gossip_port=base + 10 * i,
+            leader_port=base + 10 * i + 1,
+            member_port=base + 10 * i + 2,
+            leader_candidates=leader_candidates,
+            storage_dir=str(tmp_path / f"node{i}" / "storage"),
+            synset_path=str(synset_path),
+            replication_factor=2,
+            dispatch_shard_size=8,
+            heartbeat_interval_s=0.1,
+            failure_timeout_s=0.5,
+            rereplication_interval_s=0.2,
+            assignment_interval_s=0.2,
+            leader_probe_interval_s=0.2,
+        )
+        node = ClusterNode(
+            cfg, backends={"resnet18": fake_backend, "alexnet": fake_backend}
+        )
+        node.start()
+        nodes.append(node)
+    # Nodes 1,2 join via node 0.
+    for n in nodes[1:]:
+        n.join(nodes[0].gossip.address)
+    wait_until(
+        lambda: all(len(n.membership.active_ids()) == 3 for n in nodes),
+        msg="3-node membership convergence",
+    )
+    yield nodes
+    for n in nodes:
+        n.stop()
+
+
+def test_full_stack_through_cli(cluster3, tmp_path):
+    nodes = cluster3
+    cli = Cli(nodes[1])  # drive from a non-leader node
+
+    # membership verbs
+    out = cli.run_command("lm")
+    assert out.count("active") == 3
+    assert nodes[1].gossip.address in cli.run_command("list_self")
+
+    # SDFS verbs through the CLI
+    src = tmp_path / "w.bin"
+    src.write_bytes(b"weights-bytes-v1")
+    out = cli.run_command(f"put {src} models/resnet18")
+    assert "1" in out
+    dst = tmp_path / "out.bin"
+    out = cli.run_command(f"get models/resnet18 {dst}")
+    assert "v1" in out
+    assert dst.read_bytes() == b"weights-bytes-v1"
+
+    src.write_bytes(b"weights-bytes-v2")
+    cli.run_command(f"put {src} models/resnet18")
+    merged = tmp_path / "merged.bin"
+    out = cli.run_command(f"gv models/resnet18 2 {merged}")
+    assert "[2, 1]" in out
+    assert b"== Version 2 ==" in merged.read_bytes()
+
+    out = cli.run_command("ls models/resnet18")
+    assert "models/resnet18" in out
+
+    # train: broadcast the weights to every member, visible in local stores
+    cli.run_command("train")
+    wait_until(
+        lambda: "models/resnet18" in Cli(nodes[2]).run_command("store"),
+        msg="train broadcast reaches node2's store",
+    )
+
+    # predict + jobs: both jobs run to completion with 100% accuracy
+    out = cli.run_command("predict")
+    assert "resnet18" in out and "alexnet" in out
+    leader = nodes[0]
+    wait_until(
+        lambda: all(j.done for j in leader.scheduler.jobs.values()),
+        msg="jobs complete",
+    )
+    out = cli.run_command("jobs")
+    assert "40/40 finished" in out
+    assert "accuracy 100.00%" in out
+    assert "p99" in out
+
+    out = cli.run_command("assign")
+    assert "resnet18" in out
+
+    # error surfaces, not crashes
+    assert "error" in cli.run_command("get no/such/file /tmp/x")
+    assert "unknown command" in cli.run_command("frobnicate")
+    assert "usage" in cli.run_command("put onlyonearg")
+
+
+def test_leader_failover_resumes_jobs(cluster3, tmp_path):
+    nodes = cluster3
+    leader, standby, member = nodes
+    cli = Cli(member)
+
+    cli.run_command("predict")
+    wait_until(
+        lambda: any(j.finished > 0 for j in leader.scheduler.jobs.values()),
+        msg="first shards complete",
+    )
+    # Standby mirrors progress before the crash.
+    wait_until(
+        lambda: any(j.finished > 0 for j in standby.scheduler.jobs.values()),
+        msg="standby state sync",
+    )
+    leader.stop()
+
+    wait_until(lambda: standby.standby.is_leader, msg="standby promotion")
+    wait_until(
+        lambda: all(j.done for j in standby.scheduler.jobs.values()),
+        msg="jobs finish under the new leader",
+    )
+    # The member-side tracker now points at the standby, so CLI verbs work.
+    wait_until(
+        lambda: member.tracker.current == standby.self_leader_addr,
+        msg="tracker advance",
+    )
+    out = cli.run_command("jobs")
+    assert "40/40 finished" in out
+    assert "accuracy 100.00%" in out

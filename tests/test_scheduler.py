@@ -1,0 +1,256 @@
+"""Scheduler + failover on the deterministic sim fabric: fair assignment,
+shard dispatch with exactly-once counting, member failure retry, leader
+failover with cursor resume (the reference's report §2-3 scenarios, scripted).
+"""
+
+import pytest
+
+from dmlc_tpu.cluster.failover import LeaderTracker, StandbyLeader
+from dmlc_tpu.cluster.rpc import SimRpcNetwork
+from dmlc_tpu.scheduler.jobs import JobScheduler
+from dmlc_tpu.scheduler.worker import PredictWorker
+
+
+def make_workload(n, prefix="n", offset=0):
+    return [(f"{prefix}{i:05d}", offset + i) for i in range(n)]
+
+
+class Fixture:
+    """N members serving fake model backends + a leader scheduler."""
+
+    def __init__(self, n_members=10, n_queries=100, shard=16, accuracy=1.0):
+        self.net = SimRpcNetwork()
+        self.live = [f"m{i}" for i in range(n_members)]
+        self.calls = {m: 0 for m in self.live}  # shards served per member
+
+        def backend_for(member, correct_frac):
+            def fn(synsets):
+                self.calls[member] += 1
+                out = []
+                for k, s in enumerate(synsets):
+                    truth = int(s[1:])
+                    # Deterministically wrong for a fraction of queries.
+                    wrong = (truth % 100) >= correct_frac * 100
+                    out.append(truth + 1 if wrong else truth)
+                return out
+
+            return fn
+
+        for m in self.live:
+            worker = PredictWorker(
+                {
+                    "resnet18": backend_for(m, accuracy),
+                    "alexnet": backend_for(m, accuracy),
+                }
+            )
+            self.net.serve(m, worker.methods())
+
+        self.scheduler = JobScheduler(
+            self.net.client("L"),
+            lambda: list(self.live),
+            jobs={
+                "resnet18": make_workload(n_queries),
+                "alexnet": make_workload(n_queries),
+            },
+            shard_size=shard,
+            timer=self._fake_timer(),
+        )
+        self.net.serve("L", self.scheduler.methods())
+
+    def _fake_timer(self):
+        t = [0.0]
+
+        def timer():
+            t[0] += 0.005
+            return t[0]
+
+        return timer
+
+    def crash(self, m):
+        self.live.remove(m)
+        self.net.crash(m)
+
+
+def test_assignment_splits_members_evenly():
+    f = Fixture()
+    f.net.client("cli").call("L", "job.start", {})
+    assigned = f.net.client("cli").call("L", "job.assignments", {})["assigned"]
+    assert len(assigned["resnet18"]) == 5
+    assert len(assigned["alexnet"]) == 5
+    assert not set(assigned["resnet18"]) & set(assigned["alexnet"])
+
+
+def test_run_to_completion_and_report():
+    f = Fixture(n_queries=100, shard=16, accuracy=1.0)
+    f.scheduler._start({})
+    f.scheduler.run_to_completion()
+    rep = f.net.client("cli").call("L", "job.report", {})["jobs"]
+    for name in ("resnet18", "alexnet"):
+        r = rep[name]
+        assert r["finished"] == r["total"] == 100
+        assert r["accuracy"] == 1.0
+        assert not r["running"]
+        for k in ("mean", "median", "p90", "p95", "p99", "std"):
+            assert k in r["query_latency"] and k in r["shard_latency"]
+    # Work spread across members: every member served at least one shard.
+    assert all(c > 0 for c in f.calls.values())
+
+
+def test_partial_accuracy_counted_exactly():
+    f = Fixture(n_queries=100, shard=10, accuracy=0.7)
+    f.scheduler._start({})
+    f.scheduler.run_to_completion()
+    job = f.scheduler.jobs["resnet18"]
+    assert job.finished == 100
+    assert job.correct == 70  # truths 0..99, wrong for (truth % 100) >= 70
+
+
+def test_member_crash_mid_run_retries_without_double_count():
+    f = Fixture(n_members=4, n_queries=64, shard=16)
+    f.scheduler._start({})
+    f.scheduler.assign_once()
+    assert f.scheduler.dispatch_once("resnet18") == 16
+    f.crash(f.scheduler.jobs["resnet18"].assigned[1 % len(f.scheduler.jobs["resnet18"].assigned)])
+    f.scheduler.run_to_completion()
+    job = f.scheduler.jobs["resnet18"]
+    assert job.finished == 64  # exactly once, despite the failed dispatch
+    assert job.correct == 64
+    assert f.scheduler.jobs["alexnet"].finished == 64
+
+
+def test_idle_scheduler_dispatches_nothing():
+    f = Fixture()
+    assert f.scheduler.dispatch_all_once() == 0  # predict never issued
+    assert f.scheduler.jobs["resnet18"].finished == 0
+
+
+def test_leader_tracker_advances_and_wraps():
+    net = SimRpcNetwork()
+    for addr in ("L0", "L1", "L2"):
+        net.serve(addr, {"leader.alive": lambda p: {"ok": True}})
+    t = LeaderTracker(net.client("m"), ["L0", "L1", "L2"])
+    assert t.probe() and t.current == "L0"
+    net.crash("L0")
+    assert not t.probe()  # advance to L1
+    assert t.probe() and t.current == "L1"
+    net.crash("L1")
+    net.crash("L2")
+    assert not t.probe()  # -> L2
+    assert not t.probe()  # -> L0 (wrap)
+    assert t.current == "L0"
+    net.restart("L0")
+    assert t.probe()
+
+
+def test_failover_resumes_from_cursor():
+    f = Fixture(n_members=6, n_queries=80, shard=16)
+    f.scheduler.is_leading = True  # primary actively leads
+    f.scheduler._start({})
+    f.scheduler.assign_once()
+    # Primary completes 2 shards of each job, then standby syncs.
+    for _ in range(2):
+        f.scheduler.dispatch_once("resnet18")
+        f.scheduler.dispatch_once("alexnet")
+    standby = JobScheduler(
+        f.net.client("L1"),
+        lambda: list(f.live),
+        jobs={"resnet18": make_workload(80), "alexnet": make_workload(80)},
+        shard_size=16,
+        timer=f._fake_timer(),
+    )
+    monitor = StandbyLeader(f.net.client("L1"), "L1", ["L", "L1"], standby)
+    monitor.step()  # mirrors primary state
+    assert standby.jobs["resnet18"].finished == 32
+    assert not monitor.is_leader
+
+    shards_before = dict(f.calls)
+    f.net.crash("L")
+    monitor.step()  # primary dead -> promote + auto-resume
+    assert monitor.is_leader
+    assert standby.jobs["resnet18"].running
+    standby.run_to_completion()
+    for name in ("resnet18", "alexnet"):
+        assert standby.jobs[name].finished == 80
+        assert standby.jobs[name].correct == 80
+    # Resume really started at the cursor: exactly (80-32)/16 = 3 more shards
+    # per job were served cluster-wide.
+    extra = sum(f.calls.values()) - sum(shards_before.values())
+    assert extra == 6
+
+
+def test_adopt_state_never_rewinds():
+    f = Fixture(n_queries=64, shard=16)
+    f.scheduler._start({})
+    f.scheduler.assign_once()
+    f.scheduler.dispatch_once("resnet18")
+    f.scheduler.dispatch_once("resnet18")
+    stale = {
+        "jobs": {
+            "resnet18": {
+                "model": "resnet18",
+                "finished": 16,
+                "correct": 16,
+                "running": True,
+                "query_samples": [],
+                "shard_samples": [],
+            }
+        }
+    }
+    f.scheduler.adopt_state(stale)
+    assert f.scheduler.jobs["resnet18"].finished == 32  # stale snapshot ignored
+
+
+def test_rebooted_ex_leader_defers_to_active_leader():
+    """A restarted first-candidate must NOT reclaim leadership while another
+    candidate actively leads (the dual-leader bug)."""
+    net = SimRpcNetwork()
+    live = ["m0", "m1"]
+    active = JobScheduler(net.client("L1"), lambda: list(live), jobs={"j": make_workload(8)})
+    active.is_leading = True
+    net.serve("L1", active.methods())
+    rebooted = JobScheduler(net.client("L0"), lambda: list(live), jobs={"j": make_workload(8)})
+    net.serve("L0", rebooted.methods())
+    monitor = StandbyLeader(net.client("L0"), "L0", ["L0", "L1"], rebooted)
+    monitor.step()
+    assert not monitor.is_leader  # defers despite being first in the list
+    # Only once the active leader dies does the rebooted one take over.
+    net.crash("L1")
+    monitor.step()
+    assert monitor.is_leader
+
+
+def test_standby_mirrors_sdfs_directory(tmp_path):
+    """Failover must not orphan the SDFS directory (files + versions)."""
+    from dmlc_tpu.cluster.sdfs import MemberStore, SdfsClient, SdfsLeader, SdfsMember
+
+    net = SimRpcNetwork()
+    live = ["m0", "m1", "m2"]
+    stores = {}
+    for m in live:
+        store = MemberStore(tmp_path / m)
+        net.serve(m, SdfsMember(store, net.client(m)).methods())
+        stores[m] = store
+    primary_sdfs = SdfsLeader(net.client("L0"), lambda: list(live), replication_factor=2)
+    primary_jobs = JobScheduler(net.client("L0"), lambda: list(live), jobs={})
+    primary_jobs.is_leading = True
+    net.serve("L0", {**primary_sdfs.methods(), **primary_jobs.methods()})
+
+    client = SdfsClient(net.client("m0"), "L0", stores["m0"], "m0")
+    client.put_bytes(b"v1", "w")
+    client.put_bytes(b"v2", "w")
+
+    standby_sdfs = SdfsLeader(net.client("L1"), lambda: list(live), replication_factor=2)
+    standby_jobs = JobScheduler(net.client("L1"), lambda: list(live), jobs={})
+    net.serve("L1", {**standby_sdfs.methods(), **standby_jobs.methods()})
+    monitor = StandbyLeader(net.client("L1"), "L1", ["L0", "L1"], standby_jobs, sdfs_leader=standby_sdfs)
+    monitor.step()  # mirrors directory
+    assert standby_sdfs.state.latest_version("w") == 2
+
+    net.crash("L0")
+    monitor.step()
+    assert monitor.is_leader
+    # Post-failover: get resolves, and a new put gets v3, never recycles v1.
+    client.leader_addr = "L1"
+    v, data = client.get_bytes("w")
+    assert (v, data) == (2, b"v2")
+    assert client.put_bytes(b"v3", "w")["version"] == 3

@@ -164,17 +164,30 @@ def test_put_with_no_members_errors(tmp_path):
         client.put_bytes(b"d", "f")
 
 
-def test_storage_filename_sanitizes():
-    assert storage_filename("a/b\\c", 3) == "v3.a_b_c"
+def test_storage_filename_sanitizes_without_collisions():
+    fn = storage_filename("a/b\\c", 3)
+    assert fn.startswith("v3.") and fn.endswith(".a_b_c")
+    # Distinct names that sanitize identically must get distinct filenames.
+    assert storage_filename("a/b", 1) != storage_filename("a_b", 1)
+
+
+def test_colliding_names_coexist_on_one_member(tmp_path):
+    store = MemberStore(tmp_path / "s")
+    store.receive("a/b", 1, b"slash")
+    store.receive("a_b", 1, b"underscore")
+    assert store.read("a/b", 1) == b"slash"
+    assert store.read("a_b", 1) == b"underscore"
+    store.delete("a_b")
+    assert store.read("a/b", 1) == b"slash"  # survives the sibling's delete
 
 
 def test_boot_wipes_stale_store(tmp_path):
     store = MemberStore(tmp_path / "s")
     store.receive("f", 1, b"old")
-    assert (tmp_path / "s" / "v1.f").exists()
+    assert list((tmp_path / "s").iterdir())
     fresh = MemberStore(tmp_path / "s")  # reboot
     assert fresh.listing() == {}
-    assert not (tmp_path / "s" / "v1.f").exists()
+    assert not list((tmp_path / "s").iterdir())
 
 
 def test_concurrent_puts_get_distinct_versions(tmp_path):
