@@ -41,17 +41,21 @@ class LeaderTracker:
         return self.candidates[self.index]
 
     def probe(self, timeout: float = 2.0) -> bool:
-        """One liveness check; advances to the next candidate on failure.
-        Returns True if the current (possibly just-advanced-to) leader
-        answered."""
+        """One check; advances to the next candidate unless the current one
+        is reachable AND actively leading. Liveness alone is not enough: a
+        rebooted ex-leader answers RPCs as a deferring standby, and routing
+        verbs there would mutate state its sync loop immediately overwrites."""
         try:
-            self.rpc.call(self.current, "leader.alive", {}, timeout=timeout)
-            return True
-        except (RpcUnreachable, RpcError):
-            prev = self.current
-            self.index = (self.index + 1) % len(self.candidates)
-            log.warning("leader %s unresponsive; trying %s", prev, self.current)
-            return False
+            status = self.rpc.call(self.current, "leader.status", {}, timeout=timeout)
+            if status.get("leading"):
+                return True
+            reason = "alive but not leading"
+        except (RpcUnreachable, RpcError) as e:
+            reason = str(e)
+        prev = self.current
+        self.index = (self.index + 1) % len(self.candidates)
+        log.warning("leader %s (%s); trying %s", prev, reason, self.current)
+        return False
 
 
 class StandbyLeader:

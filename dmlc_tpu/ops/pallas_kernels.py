@@ -1,0 +1,115 @@
+"""Pallas TPU kernels for the inference hot path.
+
+Two memory-bound steps surround the model's matmuls: input normalization
+(uint8 -> scaled float, the replacement for the reference's CPU-side
+``imagenet::load_image_and_resize`` normalize, services.rs:492) and the
+softmax/top-1 readout (services.rs:493-494). XLA fuses both well; these
+kernels exist to (a) pin the fusion — one HBM read, one write, no
+intermediate f32 image buffer — and (b) serve the standalone preprocessing
+path where there is no adjacent op to fuse into.
+
+Layout notes (per /opt/skills/guides/pallas_guide.md): images are viewed as
+[rows, W*C] 2-D blocks so the lane dimension is dense; normalization is
+expressed as one fused multiply-add ``u8 * scale + bias`` with per-column
+vectors precomputed on the host (scale = 1/(255*std), bias = -mean/std).
+Off-TPU the kernels run in interpreter mode so tests stay hermetic.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# uint8 -> normalized float (NHWC)
+# ---------------------------------------------------------------------------
+
+
+def _normalize_kernel(u8_ref, scale_ref, bias_ref, out_ref):
+    # Mosaic has no direct u8->f32 cast; widen through i32 (free on the VPU).
+    x = u8_ref[:].astype(jnp.int32).astype(jnp.float32)
+    out_ref[:] = (x * scale_ref[:] + bias_ref[:]).astype(out_ref.dtype)
+
+
+@partial(jax.jit, static_argnames=("out_dtype",))
+def _normalize_call(u8_2d, scale_row, bias_row, out_dtype):
+    rows, cols = u8_2d.shape
+    block_rows = min(rows, 512)
+    grid = (pl.cdiv(rows, block_rows),)
+    return pl.pallas_call(
+        _normalize_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, cols), out_dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, cols), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, cols), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, cols), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_rows, cols), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(u8_2d, scale_row, bias_row)
+
+
+def normalize_u8(batch_u8, mean, std, out_dtype=jnp.float32):
+    """uint8 [N, H, W, C] -> ((x/255) - mean) / std as ``out_dtype``.
+
+    One fused pass: each byte is read once, multiplied and shifted by
+    per-channel constants, and written once — no intermediate f32 image.
+    """
+    n, h, w, c = batch_u8.shape
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    scale = np.tile(1.0 / (255.0 * std), w)[None, :]   # [1, W*C]
+    bias = np.tile(-mean / std, w)[None, :]            # [1, W*C]
+    u8_2d = batch_u8.reshape(n * h, w * c)
+    out = _normalize_call(u8_2d, jnp.asarray(scale), jnp.asarray(bias), out_dtype)
+    return out.reshape(n, h, w, c)
+
+
+# ---------------------------------------------------------------------------
+# fused softmax + top-1 readout
+# ---------------------------------------------------------------------------
+
+
+def _softmax_top1_kernel(logits_ref, idx_ref, prob_ref):
+    x = logits_ref[:].astype(jnp.float32)              # [B, C]
+    m = jnp.max(x, axis=1, keepdims=True)              # [B, 1]
+    z = jnp.sum(jnp.exp(x - m), axis=1, keepdims=True)
+    # softmax peak = exp(m - m) / z = 1/z; argmax is dtype-stable.
+    idx_ref[:] = jnp.argmax(x, axis=1, keepdims=True).astype(jnp.int32)
+    prob_ref[:] = 1.0 / z
+
+
+@jax.jit
+def softmax_top1(logits):
+    """[B, C] logits -> (top-1 index int32 [B], top-1 prob float32 [B]) in a
+    single pass — the full softmax matrix is never materialized in HBM."""
+    b, c = logits.shape
+    block_b = min(b, 256)
+    idx, prob = pl.pallas_call(
+        _softmax_top1_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((b, 1), jnp.int32),
+            jax.ShapeDtypeStruct((b, 1), jnp.float32),
+        ),
+        grid=(pl.cdiv(b, block_b),),
+        in_specs=[
+            pl.BlockSpec((block_b, c), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        ],
+        out_specs=(
+            pl.BlockSpec((block_b, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_b, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ),
+        interpret=_interpret(),
+    )(logits)
+    return idx[:, 0], prob[:, 0]

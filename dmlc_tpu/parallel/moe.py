@@ -1,0 +1,114 @@
+"""Expert parallelism: Mixture-of-Experts layer sharded over an ``ep`` axis.
+
+The reference has no expert parallelism (SURVEY.md §2: "Expert parallel:
+Absent"). This is the TPU-idiomatic Mesh-TensorFlow/GShard formulation:
+routing produces dense one-hot dispatch/combine tensors, expert compute is
+one batched einsum over a leading expert axis, and the expert axis is
+sharded over ``ep`` — under jit, XLA lowers the token->expert and
+expert->token einsums to all_to_all collectives over ICI. No gather/scatter,
+no ragged shapes, fully static: exactly the shape the MXU and the compiler
+want.
+
+Capacity semantics: each expert processes at most ``capacity`` tokens per
+batch; overflow tokens fall through the residual connection (standard GShard
+behavior), so shapes stay static regardless of routing skew.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def top1_routing(logits: jax.Array, capacity: int):
+    """GShard-style top-1 routing with per-expert capacity.
+
+    logits: [T, E]. Returns (dispatch [T, E, C] one-hot, combine [T, E, C]
+    gate-weighted, aux_loss scalar).
+    """
+    t, e = logits.shape
+    gates = jax.nn.softmax(logits, axis=-1)              # [T, E]
+    expert = jnp.argmax(gates, axis=-1)                   # [T]
+    onehot = jax.nn.one_hot(expert, e, dtype=logits.dtype)  # [T, E]
+    # Position of each token in its expert's queue (cumulative count).
+    position = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # [T, E], -1 elsewhere
+    kept = (position >= 0) & (position < capacity)
+    pos_oh = jax.nn.one_hot(
+        position.max(axis=-1).astype(jnp.int32), capacity, dtype=logits.dtype
+    )  # [T, C]
+    dispatch = onehot[:, :, None] * pos_oh[:, None, :] * kept.max(axis=-1)[:, None, None]
+    gate = (gates * onehot).sum(-1)                       # [T] chosen gate value
+    combine = dispatch * gate[:, None, None]
+    # Load-balancing aux loss (Switch/GShard): mean_gates . mean_assignment * E
+    density = onehot.mean(axis=0)
+    density_proxy = gates.mean(axis=0)
+    aux = (density * density_proxy).sum() * e
+    return dispatch, combine, aux
+
+
+class MoEMlp(nn.Module):
+    """Expert-parallel MLP block: router -> E expert FFNs -> combine.
+
+    Input [T, D] tokens (flatten batch x sequence first), output [T, D].
+    Expert params have leading axis E — shard it over ``ep`` with
+    ``moe_param_shardings``.
+    """
+
+    num_experts: int
+    hidden_dim: int
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        t, d = x.shape
+        e = self.num_experts
+        capacity = max(1, int(self.capacity_factor * t / e))
+        router = nn.Dense(e, dtype=jnp.float32, param_dtype=jnp.float32, name="router")
+        dispatch, combine, aux = top1_routing(router(x.astype(jnp.float32)), capacity)
+        dispatch = dispatch.astype(self.dtype)
+        combine = combine.astype(self.dtype)
+
+        w_in = self.param(
+            "w_in", nn.initializers.lecun_normal(), (e, d, self.hidden_dim), jnp.float32
+        )
+        w_out = self.param(
+            "w_out", nn.initializers.lecun_normal(), (e, self.hidden_dim, d), jnp.float32
+        )
+        # Token -> expert buffers: XLA lowers this to an all_to_all when the
+        # e axis is sharded over ep.
+        xs = jnp.einsum("tec,td->ecd", dispatch, x.astype(self.dtype))  # [E, C, D]
+        h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", xs, w_in.astype(self.dtype)))
+        ys = jnp.einsum("ech,ehd->ecd", h, w_out.astype(self.dtype))    # [E, C, D]
+        # Expert -> token combine (the reverse all_to_all) + residual for
+        # dropped tokens (combine rows are all-zero for them).
+        out = jnp.einsum("tec,ecd->td", combine, ys)
+        self.sow("intermediates", "aux_loss", aux)
+        return x + out.astype(x.dtype)
+
+
+def moe_param_spec(path: tuple[str, ...], leaf) -> P:
+    """Partition rule: expert weights shard their leading E axis over ep;
+    the router stays replicated."""
+    names = [str(p) for p in path]
+    if any(n in ("w_in", "w_out") for n in names):
+        return P("ep")
+    return P()
+
+
+def moe_param_shardings(mesh: Mesh, variables):
+    def one(path, leaf):
+        names = tuple(str(getattr(p, "key", p)) for p in path)
+        return NamedSharding(mesh, moe_param_spec(names, leaf))
+
+    return jax.tree_util.tree_map_with_path(one, variables)
+
+
+def shard_moe_params(mesh: Mesh, variables):
+    return jax.tree_util.tree_map(
+        jax.device_put, variables, moe_param_shardings(mesh, variables)
+    )

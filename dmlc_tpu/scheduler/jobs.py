@@ -101,6 +101,7 @@ class JobScheduler:
         jobs: dict[str, list[tuple[str, int]]],
         shard_size: int = 64,
         timer=None,
+        shard_timeout_s: float = 120.0,
     ):
         import time
 
@@ -108,6 +109,7 @@ class JobScheduler:
         self.active_members = active_members
         self.shard_size = int(shard_size)
         self.timer = timer or time.perf_counter
+        self.shard_timeout_s = float(shard_timeout_s)
         self.jobs: dict[str, Job] = {
             name: Job(model_name=name, queries=list(qs)) for name, qs in jobs.items()
         }
@@ -120,13 +122,20 @@ class JobScheduler:
 
     def methods(self) -> dict:
         return {
-            "job.start": self._start,
+            "job.start": self._start_rpc,
             "job.report": self._report,
             "job.state": self._state,
             "job.assignments": self._assignments,
             "leader.alive": lambda p: {"ok": True},
             "leader.status": lambda p: {"leading": self.is_leading},
         }
+
+    def _start_rpc(self, p: dict) -> dict:
+        """RPC guard: only the active leader accepts `predict` — a deferring
+        standby would mark jobs running without ever dispatching them."""
+        if not self.is_leading:
+            raise RpcError("not the active leader")
+        return self._start(p)
 
     def _start(self, p: dict) -> dict:
         """The `predict` verb: mark every job running (resumes from cursor)."""
@@ -156,14 +165,16 @@ class JobScheduler:
         sorted index — the reference's 50/50 split generalized to K jobs."""
         members = sorted(self.active_members())
         with self._lock:
-            running = [j for j in self.jobs.values() if j.running and not j.done]
-            for job in self.jobs.values():
-                if job not in running:
+            running = [n for n, j in self.jobs.items() if j.running and not j.done]
+            for name, job in self.jobs.items():
+                if name not in running:
                     job.assigned = []
             if not running:
                 return
-            for i, job in enumerate(running):
-                job.assigned = [m for k, m in enumerate(members) if k % len(running) == i]
+            for i, name in enumerate(running):
+                self.jobs[name].assigned = [
+                    m for k, m in enumerate(members) if k % len(running) == i
+                ]
 
     # ---- dispatch (services.rs:407-433, shard-ized) --------------------
 
@@ -195,7 +206,11 @@ class JobScheduler:
                 member,
                 "job.predict",
                 {"model": job.model_name, "synsets": synsets},
-                timeout=3600.0,  # reference uses a 1 h deadline for long ops (main.rs:132)
+                # One shard is one batched forward: seconds. A bounded
+                # timeout keeps a wedged member from stalling every job for
+                # the reference's 1 h deadline (main.rs:132); on expiry the
+                # shard simply retries on the next assigned member.
+                timeout=self.shard_timeout_s,
             )
         except (RpcUnreachable, RpcError) as e:
             log.warning("shard dispatch %s -> %s failed: %s", job_name, member, e)
@@ -211,7 +226,7 @@ class JobScheduler:
             job.finished += len(shard)
             job.correct += sum(1 for (_, truth), p in zip(shard, preds) if int(p) == truth)
             job.shard_stats.record(elapsed)
-            job.query_stats.extend([elapsed / len(shard)] * len(shard))
+            job.query_stats.record_many(elapsed / len(shard), len(shard))
             if job.done:
                 job.running = False
         return len(shard)

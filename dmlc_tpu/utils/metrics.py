@@ -1,60 +1,103 @@
-"""Latency/accuracy metrics with percentile reporting.
+"""Latency/accuracy metrics with percentile reporting, bounded memory.
 
 Capability parity with the reference's ``jobs`` report, which aggregates
 per-query wall-clock durations into mean/std/median/p90/p95/p99 via the
 ``histogram`` crate (reference: src/main.rs:282-309) and tracks
 correct/finished counts per job (src/services.rs:74-80).
 
-Here durations are recorded per *batch* as well as per *query* — on TPU the
-unit of execution is a sharded batch, so we keep both: per-batch device
-latency (what the chip did) and per-query amortized latency (what the
-reference reported).
+Unlike the reference's grow-forever Vec of durations (services.rs:78), this
+collector is O(1) memory at any query volume: count/mean/std come from exact
+Welford moments, percentiles from a fixed-size reservoir (Algorithm R with a
+deterministic PRNG so simulator runs reproduce). That also bounds the wire
+payload standby leaders mirror every probe interval — at the >10k img/s
+target an exact sample list would cross the RPC frame limit within hours.
 """
 
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
+import random
 
 
-@dataclass
 class LatencyStats:
-    """Streaming collection of durations (seconds) with percentile summary."""
+    """Streaming duration collector (seconds) with percentile summary."""
 
-    samples: list[float] = field(default_factory=list)
+    RESERVOIR_SIZE = 4096
+
+    def __init__(self, samples: list[float] | None = None):
+        self.n = 0
+        self._mean = 0.0
+        self._m2 = 0.0
+        self.reservoir: list[float] = []
+        self._rng = random.Random(0xD31C)
+        if samples:
+            self.extend(samples)
+
+    # ---- recording -----------------------------------------------------
 
     def record(self, seconds: float) -> None:
-        self.samples.append(float(seconds))
+        self._moments_add(float(seconds), 1)
+        self._reservoir_offer(float(seconds))
+
+    def record_many(self, seconds: float, count: int) -> None:
+        """Record ``count`` queries that shared one measured duration (a
+        shard's amortized per-query latency). Moments are exact; the
+        reservoir takes one representative offer per call, which keeps
+        every shard equally weighted in the percentile sketch."""
+        if count <= 0:
+            return
+        self._moments_add(float(seconds), int(count))
+        self._reservoir_offer(float(seconds))
 
     def extend(self, seconds: list[float]) -> None:
-        self.samples.extend(float(s) for s in seconds)
+        for s in seconds:
+            self.record(float(s))
+
+    def _moments_add(self, value: float, count: int) -> None:
+        # Chan et al. parallel update: fold `count` copies of `value` in.
+        n2 = self.n + count
+        delta = value - self._mean
+        self._mean += delta * count / n2
+        self._m2 += delta * delta * count * self.n / n2
+        self.n = n2
+
+    def _reservoir_offer(self, value: float) -> None:
+        if len(self.reservoir) < self.RESERVOIR_SIZE:
+            self.reservoir.append(value)
+            return
+        j = self._rng.randrange(len(self.reservoir) + 1)
+        if j < self.RESERVOIR_SIZE:
+            self.reservoir[j] = value
+
+    # ---- queries -------------------------------------------------------
 
     def __len__(self) -> int:
-        return len(self.samples)
-
-    def percentile(self, p: float) -> float:
-        """Nearest-rank percentile, p in [0, 100]."""
-        if not self.samples:
-            return float("nan")
-        xs = sorted(self.samples)
-        rank = max(1, math.ceil(p / 100.0 * len(xs)))
-        return xs[min(rank, len(xs)) - 1]
+        return self.n
 
     @property
     def mean(self) -> float:
-        return sum(self.samples) / len(self.samples) if self.samples else float("nan")
+        return self._mean if self.n else float("nan")
 
     @property
     def std(self) -> float:
-        if len(self.samples) < 2:
-            return 0.0 if self.samples else float("nan")
-        m = self.mean
-        return math.sqrt(sum((x - m) ** 2 for x in self.samples) / (len(self.samples) - 1))
+        if self.n == 0:
+            return float("nan")
+        if self.n < 2:
+            return 0.0
+        return math.sqrt(self._m2 / (self.n - 1))
+
+    def percentile(self, p: float) -> float:
+        """Nearest-rank percentile over the reservoir, p in [0, 100]."""
+        if not self.reservoir:
+            return float("nan")
+        xs = sorted(self.reservoir)
+        rank = max(1, math.ceil(p / 100.0 * len(xs)))
+        return xs[min(rank, len(xs)) - 1]
 
     def summary(self) -> dict[str, float]:
         """The reference's report shape: mean/std/median/p90/p95/p99."""
         return {
-            "count": float(len(self.samples)),
+            "count": float(self.n),
             "mean": self.mean,
             "std": self.std,
             "median": self.percentile(50),
@@ -64,11 +107,33 @@ class LatencyStats:
         }
 
     def merge(self, other: "LatencyStats") -> None:
-        self.samples.extend(other.samples)
+        if other.n == 0:
+            return
+        n2 = self.n + other.n
+        delta = other._mean - self._mean
+        self._mean += delta * other.n / n2
+        self._m2 += other._m2 + delta * delta * self.n * other.n / n2
+        self.n = n2
+        for v in other.reservoir:
+            self._reservoir_offer(v)
 
-    def to_wire(self) -> list[float]:
-        return list(self.samples)
+    # ---- wire ----------------------------------------------------------
+
+    def to_wire(self) -> dict:
+        return {
+            "n": self.n,
+            "mean": self._mean,
+            "m2": self._m2,
+            "reservoir": list(self.reservoir),
+        }
 
     @classmethod
-    def from_wire(cls, samples: list[float]) -> "LatencyStats":
-        return cls(samples=list(samples))
+    def from_wire(cls, w) -> "LatencyStats":
+        if isinstance(w, list):  # legacy raw-sample form
+            return cls(samples=w)
+        out = cls()
+        out.n = int(w["n"])
+        out._mean = float(w["mean"])
+        out._m2 = float(w["m2"])
+        out.reservoir = [float(x) for x in w["reservoir"]][: cls.RESERVOIR_SIZE]
+        return out

@@ -71,6 +71,9 @@ def cluster3(tmp_path):
         lambda: all(len(n.membership.active_ids()) == 3 for n in nodes),
         msg="3-node membership convergence",
     )
+    # Leadership is claimed via the standby loop, not assumed at boot; the
+    # CLI verbs need an active leader.
+    wait_until(lambda: nodes[0].standby.is_leader, msg="first-leader promotion")
     yield nodes
     for n in nodes:
         n.stop()

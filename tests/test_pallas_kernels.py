@@ -1,0 +1,41 @@
+"""Pallas kernels vs plain-jnp references (interpreter mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dmlc_tpu.ops import preprocess as pp
+from dmlc_tpu.ops.pallas_kernels import normalize_u8, softmax_top1
+
+
+def test_normalize_matches_reference():
+    rng = np.random.default_rng(0)
+    batch = rng.integers(0, 256, (4, 32, 32, 3), np.uint8)
+    got = np.asarray(normalize_u8(batch, pp.IMAGENET_MEAN, pp.IMAGENET_STD))
+    want = np.asarray(pp.normalize(batch))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_normalize_bf16_output():
+    rng = np.random.default_rng(1)
+    batch = rng.integers(0, 256, (2, 16, 16, 3), np.uint8)
+    out = normalize_u8(batch, pp.IMAGENET_MEAN, pp.IMAGENET_STD, out_dtype=jnp.bfloat16)
+    assert out.dtype == jnp.bfloat16
+    want = np.asarray(pp.normalize(batch), np.float32)
+    np.testing.assert_allclose(np.asarray(out, np.float32), want, rtol=1e-2, atol=1e-2)
+
+
+def test_softmax_top1_matches_reference():
+    rng = np.random.default_rng(2)
+    logits = jnp.asarray(rng.normal(size=(32, 1000)).astype(np.float32) * 4)
+    idx, prob = softmax_top1(logits)
+    ref = jax.nn.softmax(logits, axis=-1)
+    np.testing.assert_array_equal(np.asarray(idx), np.argmax(np.asarray(logits), -1))
+    np.testing.assert_allclose(np.asarray(prob), np.max(np.asarray(ref), -1), rtol=1e-5)
+
+
+def test_softmax_top1_extreme_logits_stable():
+    logits = jnp.array([[1e4, -1e4, 0.0, 9.9e3]], jnp.float32)
+    idx, prob = softmax_top1(logits)
+    assert int(idx[0]) == 0
+    assert np.isfinite(float(prob[0])) and 0 < float(prob[0]) <= 1.0

@@ -55,6 +55,7 @@ class Fixture:
             shard_size=shard,
             timer=self._fake_timer(),
         )
+        self.scheduler.is_leading = True  # fixture models the active leader
         self.net.serve("L", self.scheduler.methods())
 
     def _fake_timer(self):
@@ -126,8 +127,9 @@ def test_idle_scheduler_dispatches_nothing():
 
 def test_leader_tracker_advances_and_wraps():
     net = SimRpcNetwork()
+    leading = {"L0": True, "L1": True, "L2": True}
     for addr in ("L0", "L1", "L2"):
-        net.serve(addr, {"leader.alive": lambda p: {"ok": True}})
+        net.serve(addr, {"leader.status": (lambda a: lambda p: {"leading": leading[a]})(addr)})
     t = LeaderTracker(net.client("m"), ["L0", "L1", "L2"])
     assert t.probe() and t.current == "L0"
     net.crash("L0")
@@ -140,6 +142,10 @@ def test_leader_tracker_advances_and_wraps():
     assert t.current == "L0"
     net.restart("L0")
     assert t.probe()
+    # Alive-but-deferring candidates are skipped too, not just dead ones.
+    leading["L0"] = False
+    assert not t.probe()
+    assert t.current == "L1"
 
 
 def test_failover_resumes_from_cursor():

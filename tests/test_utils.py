@@ -66,9 +66,23 @@ class TestLatencyStats:
     def test_wire_roundtrip_and_merge(self):
         a = LatencyStats([1.0, 2.0])
         b = LatencyStats.from_wire(a.to_wire())
-        assert b.samples == [1.0, 2.0]
+        assert b.reservoir == [1.0, 2.0]
+        assert b.mean == pytest.approx(1.5)
         b.merge(LatencyStats([3.0]))
         assert len(b) == 3
+        assert b.mean == pytest.approx(2.0)
+        # Legacy raw-sample wire form still decodes.
+        assert LatencyStats.from_wire([1.0, 3.0]).mean == pytest.approx(2.0)
+
+    def test_bounded_memory_under_load(self):
+        s = LatencyStats()
+        for i in range(50_000):
+            s.record_many(0.001 * (i % 100), 256)
+        assert len(s.reservoir) <= LatencyStats.RESERVOIR_SIZE
+        assert s.n == 50_000 * 256
+        assert s.mean == pytest.approx(0.001 * 49.5, rel=1e-6)
+        wire = s.to_wire()
+        assert len(wire["reservoir"]) <= LatencyStats.RESERVOIR_SIZE
 
 
 class TestConfig:
