@@ -146,6 +146,9 @@ class ClusterNode:
         engines warm up first — compile-time GIL holds must not starve the
         heartbeat threads into a false FAILED verdict."""
         if self.config.eager_load:
+            from dmlc_tpu import native
+
+            native.ensure_built()  # compile off the hot path, before serving
             for backend in self.worker.backends.values():
                 if hasattr(backend, "warmup"):
                     backend.warmup()

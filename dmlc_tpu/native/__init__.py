@@ -1,0 +1,111 @@
+"""ctypes bindings for the native (C++) data-plane library.
+
+``decode_resize_batch`` is the high-throughput replacement for the PIL path
+in ops/preprocess.py — libjpeg DCT-domain downscaling + thread-pooled
+triangle resampling (PIL BILINEAR semantics), one call per shard. The
+library builds from native/ via make; when it is absent the callers fall
+back to PIL transparently, so nothing in the framework hard-requires the
+toolchain at runtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_LIB_PATH = Path(__file__).parent / "libdmlc_native.so"
+_SRC_DIR = Path(__file__).parent.parent.parent / "native"
+_ABI_VERSION = 1
+
+_lib = None
+_load_failed = False
+
+
+def _load():
+    """Bind to an ALREADY-BUILT library. Never compiles: _load sits on the
+    serving hot path (load_batch -> available()), and a surprise g++ run
+    there would stall the first inference shard. Compilation happens only
+    through ensure_built()/build(), called from node startup and bench."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    if not _LIB_PATH.exists():
+        return None
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        if lib.dmlc_native_abi_version() != _ABI_VERSION:
+            log.warning("native library ABI mismatch; rebuild with native.build()")
+            return None
+        lib.dmlc_decode_resize_batch.restype = ctypes.c_int
+        lib.dmlc_decode_resize_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.c_int,
+        ]
+        _lib = lib
+    except Exception as e:
+        log.warning("native image pipeline unavailable (%s); using PIL", e)
+        _load_failed = True
+    return _lib
+
+
+def build() -> None:
+    """Compile the library (g++ via make). Raises on failure."""
+    global _lib, _load_failed
+    subprocess.run(
+        ["make", "-s"], cwd=_SRC_DIR, check=True, capture_output=True, text=True
+    )
+    _lib, _load_failed = None, False  # rebind on next use
+
+
+def ensure_built() -> bool:
+    """Build if missing (best effort) and report availability. Call at node
+    startup / bench setup — never from the per-shard path."""
+    if not _LIB_PATH.exists() and not _load_failed:
+        try:
+            build()
+        except Exception as e:
+            log.warning("native build failed (%s); PIL fallback stays active", e)
+    return available()
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def decode_resize_batch(
+    paths, size: int = 224, workers: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """Decode+resize JPEGs -> (uint8 [N, size, size, 3], status int32 [N]).
+
+    status[i] != 0 marks a failed decode (that slot is zeros). Raises
+    RuntimeError if the native library is unavailable — callers that want
+    the automatic PIL fallback go through ops.preprocess.load_batch.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native image pipeline not available")
+    n = len(paths)
+    out = np.empty((n, size, size, 3), np.uint8)
+    status = np.zeros(n, np.int32)
+    if n == 0:
+        return out, status
+    c_paths = (ctypes.c_char_p * n)(*[str(p).encode() for p in paths])
+    lib.dmlc_decode_resize_batch(
+        c_paths,
+        n,
+        size,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        status.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        int(workers),
+    )
+    return out, status

@@ -29,6 +29,7 @@ from dmlc_tpu.models import get_model
 from dmlc_tpu.ops import preprocess as pp
 from dmlc_tpu.parallel import mesh as mesh_lib
 from dmlc_tpu.utils.metrics import LatencyStats
+from dmlc_tpu.utils.tracing import tracer
 
 
 @dataclass
@@ -123,6 +124,7 @@ class InferenceEngine:
         out = jax.block_until_ready(out)
         dt = time.perf_counter() - t0
         self._stats.record(dt)
+        tracer.record("device/forward", dt, model=self.spec.name, batch=int(n))
         if self.spec.classifier:
             idx, top = (np.asarray(o) for o in out)
             return BatchResult(idx[:n], top[:n], None, dt)
@@ -131,7 +133,8 @@ class InferenceEngine:
 
     def run_paths(self, paths: Sequence[str], workers: int | None = None) -> BatchResult:
         """Decode + resize on host threads, then one device batch."""
-        batch = pp.load_batch(paths, size=self.input_size, workers=workers)
+        with tracer.span("host/decode", n=len(paths)):
+            batch = pp.load_batch(paths, size=self.input_size, workers=workers)
         return self.run_batch(batch)
 
     def latency_summary(self) -> dict[str, float]:

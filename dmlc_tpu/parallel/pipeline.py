@@ -93,19 +93,28 @@ def pipeline_apply(
     *,
     n_micro: int,
     axis_name: str = "pp",
+    batch_axis: str | None = "dp",
 ):
     """Run ``x`` through the pipeline.
 
     stage_fn(params, activation[mb, ...]) -> activation[mb, ...]
     stacked_params: pytree with leading stage axis == mesh.shape[axis_name]
-    x: [batch, ...]; batch must divide into n_micro microbatches.
-    Returns [batch, ...] outputs (replicated over pp).
+    x: [batch, ...]; batch must divide into n_micro microbatches (and each
+    microbatch over the mesh's ``batch_axis`` when present — dp and pp
+    compose: every dp replica pipelines its own slice of each microbatch).
+    Returns [batch, ...] outputs.
     """
-    n_stages = mesh.shape[axis_name]
     batch = x.shape[0]
     if batch % n_micro:
         raise ValueError(f"batch {batch} not divisible into {n_micro} microbatches")
-    xm = x.reshape(n_micro, batch // n_micro, *x.shape[1:])
+    mb = batch // n_micro
+    use_dp = batch_axis is not None and batch_axis in mesh.axis_names
+    if use_dp and mb % mesh.shape[batch_axis]:
+        raise ValueError(
+            f"microbatch {mb} not divisible over {batch_axis}={mesh.shape[batch_axis]}"
+        )
+    xm = x.reshape(n_micro, mb, *x.shape[1:])
+    data_spec = P(None, batch_axis) if use_dp else P()
 
     param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
     fn = partial(
@@ -114,9 +123,9 @@ def pipeline_apply(
     out = jax.shard_map(
         fn,
         mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(),
-        check_vma=False,  # outputs are made uniform by the final all_gather
+        in_specs=(param_specs, data_spec),
+        out_specs=data_spec,
+        check_vma=False,  # outputs are made uniform over pp by the all_gather
     )(stacked_params, xm)
     return out.reshape(batch, *out.shape[2:])
 

@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 
 from dmlc_tpu.cluster.rpc import Rpc, RpcError, RpcUnreachable
 from dmlc_tpu.utils.metrics import LatencyStats
+from dmlc_tpu.utils.tracing import tracer
 
 log = logging.getLogger(__name__)
 
@@ -202,16 +203,17 @@ class JobScheduler:
         synsets = [s for s, _ in shard]
         t0 = self.timer()
         try:
-            reply = self.rpc.call(
-                member,
-                "job.predict",
-                {"model": job.model_name, "synsets": synsets},
-                # One shard is one batched forward: seconds. A bounded
-                # timeout keeps a wedged member from stalling every job for
-                # the reference's 1 h deadline (main.rs:132); on expiry the
-                # shard simply retries on the next assigned member.
-                timeout=self.shard_timeout_s,
-            )
+            with tracer.span("scheduler/dispatch", job=job_name, member=member, n=len(shard)):
+                reply = self.rpc.call(
+                    member,
+                    "job.predict",
+                    {"model": job.model_name, "synsets": synsets},
+                    # One shard is one batched forward: seconds. A bounded
+                    # timeout keeps a wedged member from stalling every job
+                    # for the reference's 1 h deadline (main.rs:132); on
+                    # expiry the shard retries on the next assigned member.
+                    timeout=self.shard_timeout_s,
+                )
         except (RpcUnreachable, RpcError) as e:
             log.warning("shard dispatch %s -> %s failed: %s", job_name, member, e)
             return 0

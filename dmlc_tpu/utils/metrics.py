@@ -29,6 +29,7 @@ class LatencyStats:
         self._mean = 0.0
         self._m2 = 0.0
         self.reservoir: list[float] = []
+        self._offers = 0  # reservoir offers seen (Algorithm R denominator)
         self._rng = random.Random(0xD31C)
         if samples:
             self.extend(samples)
@@ -62,10 +63,14 @@ class LatencyStats:
         self.n = n2
 
     def _reservoir_offer(self, value: float) -> None:
+        # Algorithm R: the i-th offer is kept with probability K/i, so the
+        # reservoir stays a uniform sample of ALL offers, not a recency
+        # window. The denominator is offers-so-far, not reservoir size.
+        self._offers += 1
         if len(self.reservoir) < self.RESERVOIR_SIZE:
             self.reservoir.append(value)
             return
-        j = self._rng.randrange(len(self.reservoir) + 1)
+        j = self._rng.randrange(self._offers)
         if j < self.RESERVOIR_SIZE:
             self.reservoir[j] = value
 
@@ -124,6 +129,7 @@ class LatencyStats:
             "n": self.n,
             "mean": self._mean,
             "m2": self._m2,
+            "offers": self._offers,
             "reservoir": list(self.reservoir),
         }
 
@@ -136,4 +142,5 @@ class LatencyStats:
         out._mean = float(w["mean"])
         out._m2 = float(w["m2"])
         out.reservoir = [float(x) for x in w["reservoir"]][: cls.RESERVOIR_SIZE]
+        out._offers = int(w.get("offers", len(out.reservoir)))
         return out

@@ -1,0 +1,122 @@
+"""Trace spans: lightweight instrumentation for the cluster and device path.
+
+The reference's only observability is per-query `Instant` timing at the
+scheduler (src/services.rs:419-424) plus log lines. Here every subsystem can
+open named spans (thread-safe, ~no overhead when disabled); the collector
+exports
+
+- per-name aggregates (count/mean/percentiles via LatencyStats), and
+- Chrome trace-event JSON (chrome://tracing / Perfetto compatible) for
+  timeline inspection of e.g. decode vs device-dispatch overlap.
+
+Device work is asynchronous under JAX; callers that want true device time
+wrap the block_until_ready boundary (as InferenceEngine.run_batch does).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from dmlc_tpu.utils.metrics import LatencyStats
+
+
+@dataclass
+class SpanRecord:
+    name: str
+    start_s: float
+    duration_s: float
+    thread_id: int
+    attrs: dict = field(default_factory=dict)
+
+
+class Tracer:
+    """Span collector. Disabled by default; enabling costs one branch per
+    span entry. Bounded: keeps aggregates forever, raw events up to
+    ``max_events`` (newest dropped past that, aggregates stay exact)."""
+
+    def __init__(self, max_events: int = 100_000):
+        self.enabled = False
+        self.max_events = max_events
+        self._events: list[SpanRecord] = []
+        self._aggregates: dict[str, LatencyStats] = {}
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        if not self.enabled:
+            yield
+            return
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            dur = time.perf_counter() - start
+            rec = SpanRecord(name, start - self._t0, dur, threading.get_ident(), attrs)
+            with self._lock:
+                self._aggregates.setdefault(name, LatencyStats()).record(dur)
+                if len(self._events) < self.max_events:
+                    self._events.append(rec)
+
+    def record(self, name: str, duration_s: float, **attrs) -> None:
+        """Record an externally-timed duration (e.g. device execution)."""
+        if not self.enabled:
+            return
+        rec = SpanRecord(
+            name, time.perf_counter() - self._t0 - duration_s, duration_s,
+            threading.get_ident(), attrs,
+        )
+        with self._lock:
+            self._aggregates.setdefault(name, LatencyStats()).record(duration_s)
+            if len(self._events) < self.max_events:
+                self._events.append(rec)
+
+    # ---- reporting -----------------------------------------------------
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        with self._lock:
+            return {name: st.summary() for name, st in sorted(self._aggregates.items())}
+
+    def chrome_trace(self) -> list[dict]:
+        """Trace-event JSON objects (phase 'X' = complete events, µs)."""
+        with self._lock:
+            events = list(self._events)
+        return [
+            {
+                "name": e.name,
+                "ph": "X",
+                "ts": e.start_s * 1e6,
+                "dur": e.duration_s * 1e6,
+                "pid": 0,
+                "tid": e.thread_id % 1_000_000,
+                "args": e.attrs,
+            }
+            for e in events
+        ]
+
+    def export(self, path: str | Path) -> None:
+        Path(path).write_text(json.dumps({"traceEvents": self.chrome_trace()}))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._aggregates.clear()
+            self._t0 = time.perf_counter()
+
+
+# Process-global tracer: subsystems import this; tools flip .enabled.
+tracer = Tracer()
+
+
+def enable() -> Tracer:
+    tracer.enabled = True
+    return tracer
+
+
+def disable() -> None:
+    tracer.enabled = False

@@ -27,3 +27,12 @@ if _REPO_ROOT not in sys.path:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Build the native data-plane library once (best effort) so its tests run
+# against the real .so; the library is a gitignored build artifact.
+try:
+    from dmlc_tpu import native as _native  # noqa: E402
+
+    _native.ensure_built()
+except Exception:
+    pass

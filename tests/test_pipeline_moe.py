@@ -50,13 +50,20 @@ class TestPipeline:
 
     def test_various_microbatch_counts(self):
         want = reference_apply(stage_fn, self.per_stage, self.x)
-        for n_micro in (1, 2, 4, 16):
+        for n_micro in (1, 2, 4, 8):
             got = pipeline_apply(stage_fn, self.stacked, self.x, self.mesh, n_micro=n_micro)
             np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+        # Without a dp axis every microbatch may be a single row.
+        pp_only = mesh_lib.make_mesh({"pp": 4}, devices=jax.devices()[:4])
+        got = pipeline_apply(stage_fn, self.stacked, self.x, pp_only, n_micro=16)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
 
     def test_indivisible_microbatch_errors(self):
         with pytest.raises(ValueError, match="not divisible"):
             pipeline_apply(stage_fn, self.stacked, self.x, self.mesh, n_micro=5)
+        # Microbatch of 1 row cannot shard over dp=2.
+        with pytest.raises(ValueError, match="not divisible over dp"):
+            pipeline_apply(stage_fn, self.stacked, self.x, self.mesh, n_micro=16)
 
     def test_gradients_flow_through_pipeline(self):
         def loss(stacked, x):

@@ -53,10 +53,39 @@ def test_decode_resize_shape_dtype(fixture_dataset):
 
 def test_load_batch_matches_single(fixture_dataset):
     paths = [pp.class_image_path(fixture_dataset / "train", f"n{i:08d}") for i in range(8)]
-    batch = pp.load_batch(paths, size=64)
+    batch = pp.load_batch(paths, size=64, backend="pil")
     assert batch.shape == (8, 64, 64, 3)
     single = pp.decode_resize(paths[3], 64)
     np.testing.assert_array_equal(batch[3], single)
+
+
+def test_load_batch_backends_agree(fixture_dataset):
+    from dmlc_tpu import native
+
+    if not native.available():
+        pytest.skip("native pipeline not built")
+    paths = [pp.class_image_path(fixture_dataset / "train", f"n{i:08d}") for i in range(8)]
+    a = pp.load_batch(paths, size=64, backend="native").astype(np.int16)
+    b = pp.load_batch(paths, size=64, backend="pil").astype(np.int16)
+    diff = np.abs(a - b)
+    assert diff.mean() < 1.0  # JPEG-noise tolerance; resample kernels match
+    assert np.percentile(diff, 99) <= 16
+
+
+def test_load_batch_auto_falls_back_for_non_jpeg(tmp_path):
+    from PIL import Image
+
+    p = tmp_path / "img.png"  # libjpeg can't decode PNG; auto must fall back
+    rng = np.random.RandomState(1)
+    Image.fromarray(rng.randint(0, 255, (40, 40, 3), np.uint8)).save(p)
+    batch = pp.load_batch([p], size=32, backend="auto")
+    assert batch.shape == (1, 32, 32, 3)
+    assert batch.any()  # real pixels, not the native path's zero fill
+
+
+def test_load_batch_unknown_backend():
+    with pytest.raises(ValueError, match="unknown backend"):
+        pp.load_batch(["x"], backend="cuda")
 
 
 def test_normalize_values():

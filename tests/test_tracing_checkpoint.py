@@ -1,0 +1,125 @@
+"""Tracing spans and checkpoint/restore (local + SDFS-backed)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dmlc_tpu.utils import checkpoint as ckpt
+from dmlc_tpu.utils.tracing import Tracer
+
+
+class TestTracer:
+    def test_disabled_records_nothing(self):
+        t = Tracer()
+        with t.span("x"):
+            pass
+        t.record("y", 0.5)
+        assert t.summary() == {}
+
+    def test_spans_aggregate_and_export(self, tmp_path):
+        t = Tracer()
+        t.enabled = True
+        for i in range(5):
+            with t.span("host/decode", n=i):
+                pass
+        t.record("device/forward", 0.25, model="resnet18")
+        s = t.summary()
+        assert s["host/decode"]["count"] == 5
+        assert s["device/forward"]["mean"] == pytest.approx(0.25)
+        out = tmp_path / "trace.json"
+        t.export(out)
+        events = json.loads(out.read_text())["traceEvents"]
+        assert len(events) == 6
+        assert {e["name"] for e in events} == {"host/decode", "device/forward"}
+        assert all(e["ph"] == "X" and "dur" in e for e in events)
+
+    def test_span_exception_still_recorded(self):
+        t = Tracer()
+        t.enabled = True
+        with pytest.raises(RuntimeError):
+            with t.span("boom"):
+                raise RuntimeError("x")
+        assert t.summary()["boom"]["count"] == 1
+
+    def test_event_cap_keeps_aggregates_exact(self):
+        t = Tracer(max_events=10)
+        t.enabled = True
+        for _ in range(50):
+            with t.span("s"):
+                pass
+        assert t.summary()["s"]["count"] == 50
+        assert len(t.chrome_trace()) == 10
+
+
+def tiny_state():
+    import optax
+
+    from dmlc_tpu.models.vit import ViT
+    from dmlc_tpu.parallel import train as train_lib
+
+    model = ViT(num_classes=4, patch_size=8, hidden_size=16, num_layers=1,
+                num_heads=2, mlp_dim=32, dtype=jnp.float32)
+    x = jnp.zeros((2, 16, 16, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    return train_lib.create_train_state(model, variables, train_lib.default_optimizer())
+
+
+class TestLocalCheckpoint:
+    def test_roundtrip_and_latest(self, tmp_path):
+        state = tiny_state()
+        state2 = state.replace(step=state.step + 7)
+        ckpt.save_local(state, tmp_path, 0)
+        ckpt.save_local(state2, tmp_path, 7)
+        restored, step = ckpt.restore_local(state, tmp_path)
+        assert step == 7
+        assert int(restored.step) == 7
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            restored.params,
+            state2.params,
+        )
+
+    def test_empty_dir_returns_template(self, tmp_path):
+        state = tiny_state()
+        restored, step = ckpt.restore_local(state, tmp_path / "nope")
+        assert step == 0 and restored is state
+
+
+class TestSdfsCheckpoint:
+    def make_cluster(self, tmp_path):
+        from dmlc_tpu.cluster.rpc import SimRpcNetwork
+        from dmlc_tpu.cluster.sdfs import MemberStore, SdfsClient, SdfsLeader, SdfsMember
+
+        net = SimRpcNetwork()
+        live = ["m0", "m1", "m2"]
+        stores = {}
+        for m in live:
+            store = MemberStore(tmp_path / m)
+            net.serve(m, SdfsMember(store, net.client(m)).methods())
+            stores[m] = store
+        leader = SdfsLeader(net.client("L"), lambda: list(live), replication_factor=2)
+        net.serve("L", leader.methods())
+        return SdfsClient(net.client("m0"), "L", stores["m0"], "m0")
+
+    def test_versioned_save_restore(self, tmp_path):
+        client = self.make_cluster(tmp_path)
+        cp = ckpt.SdfsCheckpointer(client)
+        state = tiny_state()
+        assert cp.save(state, step=0) == 1
+        later = state.replace(step=state.step + 100)
+        assert cp.save(later, step=100) == 2
+
+        restored, step = cp.restore(state)  # latest
+        assert step == 100 and int(restored.step) == 100
+        old, step0 = cp.restore(state, version=1)  # time travel
+        assert step0 == 0 and int(old.step) == 0
+
+    def test_restore_rejects_non_checkpoint(self, tmp_path):
+        client = self.make_cluster(tmp_path)
+        client.put_bytes(b"garbage", "checkpoints/train_state")
+        cp = ckpt.SdfsCheckpointer(client)
+        with pytest.raises(ValueError, match="not a dmlc checkpoint"):
+            cp.restore(tiny_state())

@@ -84,6 +84,18 @@ class TestLatencyStats:
         wire = s.to_wire()
         assert len(wire["reservoir"]) <= LatencyStats.RESERVOIR_SIZE
 
+    def test_reservoir_is_uniform_not_recency_window(self):
+        # 100k of value 1.0 then 100k of 2.0: a uniform sample holds ~50/50;
+        # a recency window would be ~100% twos.
+        s = LatencyStats()
+        for _ in range(100_000):
+            s.record(1.0)
+        for _ in range(100_000):
+            s.record(2.0)
+        frac_twos = sum(1 for v in s.reservoir if v == 2.0) / len(s.reservoir)
+        assert 0.45 < frac_twos < 0.55
+        assert s.percentile(10) == 1.0 and s.percentile(90) == 2.0
+
 
 class TestConfig:
     def test_defaults_mirror_reference_constants(self):
