@@ -145,8 +145,11 @@ class Cli:
             return format_table(["name", "versions"], rows)
         if cmd in ("train", "t"):
             results = n.train()
-            rows = [[name, len(ms)] for name, ms in sorted(results.items())]
-            return format_table(["weights file", "members updated"], rows)
+            rows = [
+                [name, len(r["pulled"]), len(r["loaded"])]
+                for name, r in sorted(results.items())
+            ]
+            return format_table(["weights file", "members pulled", "engines loaded"], rows)
         if cmd == "predict":
             reply = n.predict()
             return f"started jobs: {', '.join(reply['jobs'])}"

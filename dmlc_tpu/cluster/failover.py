@@ -133,6 +133,8 @@ class StandbyLeader:
     def _promote(self) -> None:
         self.is_leader = True
         self.scheduler.is_leading = True
+        if self.sdfs_leader is not None:
+            self.sdfs_leader.is_leading = True
         log.warning("%s: promoting to leader", self.self_addr)
         if self.scheduler.has_history():
             # Resume interrupted jobs from the replicated cursor.

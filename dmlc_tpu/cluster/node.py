@@ -37,7 +37,7 @@ from dmlc_tpu.cluster.rpc import TcpRpc, TcpRpcServer
 from dmlc_tpu.cluster.sdfs import MemberStore, SdfsClient, SdfsLeader, SdfsMember
 from dmlc_tpu.cluster.transport import UdpTransport
 from dmlc_tpu.scheduler.jobs import JobScheduler
-from dmlc_tpu.scheduler.worker import EngineBackend, PredictWorker
+from dmlc_tpu.scheduler.worker import EngineBackend, ModelLoader, PredictWorker
 from dmlc_tpu.utils.config import ClusterConfig
 
 log = logging.getLogger(__name__)
@@ -75,7 +75,12 @@ class ClusterNode:
                 for name in config.job_models
             }
         self.worker = PredictWorker(backends)
-        methods = {**self.sdfs_member.methods(), **self.worker.methods()}
+        self.model_loader = ModelLoader(self.store, self.worker.backends)
+        methods = {
+            **self.sdfs_member.methods(),
+            **self.worker.methods(),
+            **self.model_loader.methods(),
+        }
         self.member_server = TcpRpcServer(config.host, config.member_port, methods)
         self.self_member_addr = self.member_server.address
 
@@ -110,7 +115,13 @@ class ClusterNode:
     def _start_leader_services(self) -> None:
         workload = self._load_workload()
         self.sdfs_leader = SdfsLeader(
-            self.rpc, self.active_member_addrs, self.config.replication_factor
+            self.rpc,
+            self.active_member_addrs,
+            self.config.replication_factor,
+            # Leadership is claimed via StandbyLeader.step(); until then this
+            # candidate's SDFS surface refuses writes (they would be lost to
+            # the next directory sync).
+            is_leading=False,
         )
         self.scheduler = JobScheduler(
             self.rpc,
@@ -234,33 +245,56 @@ class ClusterNode:
     def train(self) -> dict:
         """The reference's `train`: broadcast model weights to every member
         through SDFS (services.rs:139-144) — each member pulls the latest
-        weights file for each job model."""
+        weights file for each job model and hot-swaps it into its running
+        engine (the reference loads .ot files, services.rs:513-524). Pulled
+        copies are recorded in the leader directory so ls/delete see them."""
         results = {}
         for name in self.config.job_models:
             sdfs_name = f"models/{name}"
-            pulled = []
+            pulled: list[str] = []
+            loaded: list[str] = []
+            results[sdfs_name] = {"pulled": pulled, "loaded": loaded}
             try:
                 info = self.rpc.call(self.tracker.current, "sdfs.get", {"name": sdfs_name})
             except Exception as e:
                 log.warning("train: no weights for %s: %s", sdfs_name, e)
-                results[sdfs_name] = pulled
                 continue
+            have = set(info["replicas"])
             for member in self.active_member_addrs():
+                if member not in have:  # existing replicas skip the re-transfer
+                    try:
+                        self.rpc.call(
+                            member,
+                            "sdfs.replicate",
+                            {
+                                "name": sdfs_name,
+                                "version": info["version"],
+                                "source": info["replicas"][0],
+                                "from_stage": False,
+                            },
+                        )
+                        pulled.append(member)
+                    except Exception as e:
+                        log.warning("train: %s -> %s: %s", sdfs_name, member, e)
+                        continue
+                    try:
+                        self.rpc.call(
+                            self.tracker.current,
+                            "sdfs.record",
+                            {"name": sdfs_name, "version": info["version"], "member": member},
+                        )
+                    except Exception as e:
+                        log.warning("train: record %s@%s: %s", sdfs_name, member, e)
                 try:
                     self.rpc.call(
                         member,
-                        "sdfs.replicate",
-                        {
-                            "name": sdfs_name,
-                            "version": info["version"],
-                            "source": info["replicas"][0],
-                            "from_stage": False,
-                        },
+                        "model.load",
+                        {"model": name, "version": info["version"]},
+                        timeout=120.0,
                     )
-                    pulled.append(member)
+                    loaded.append(member)
                 except Exception as e:
-                    log.warning("train: %s -> %s: %s", sdfs_name, member, e)
-            results[sdfs_name] = pulled
+                    log.warning("train: load %s on %s: %s", name, member, e)
         return results
 
     def predict(self) -> dict:

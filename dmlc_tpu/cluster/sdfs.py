@@ -199,12 +199,20 @@ class SdfsLeader:
     the reference reads active_ids() the same way (services.rs:315).
     """
 
-    def __init__(self, rpc: Rpc, active_members, replication_factor: int = 4):
+    def __init__(
+        self, rpc: Rpc, active_members, replication_factor: int = 4, is_leading: bool = True
+    ):
         self.rpc = rpc
         self.active_members = active_members
         self.rf = replication_factor
         self.state = SdfsLeaderState()
         self._lock = threading.RLock()
+        # Writes are refused unless actively leading (set by StandbyLeader on
+        # promotion, like JobScheduler.is_leading): a put acked by a deferring
+        # standby would be wholesale-overwritten by its next directory sync —
+        # an acked write silently lost. Standalone single-leader use (tests,
+        # local tools) passes the default True.
+        self.is_leading = is_leading
         # Highest version handed out per file, including puts still in
         # flight — concurrent puts of one name must get distinct versions
         # even though the directory records them only after replication.
@@ -217,19 +225,27 @@ class SdfsLeader:
             "sdfs.get_versions": self._get_versions,
             "sdfs.delete": self._delete,
             "sdfs.ls": self._ls,
+            "sdfs.record": self._record,
             "sdfs.state": self._state_wire,
         }
 
+    def _require_leading(self) -> None:
+        if not self.is_leading:
+            raise RpcError("not the active leader")
+
     def _state_wire(self, p: dict) -> dict:
         """Directory replication payload for standby leaders — without it a
-        failover would orphan every stored file and recycle versions."""
+        failover would orphan every stored file and recycle versions. The
+        reservation map rides along so concurrent-put protection survives
+        failover instead of resetting."""
         with self._lock:
-            return {"directory": self.state.to_wire()}
+            return {"directory": self.state.to_wire(), "reserved": dict(self._reserved)}
 
     def adopt_state(self, wire: dict) -> None:
         """Standby sync: mirror the active leader's directory wholesale."""
         with self._lock:
             self.state = SdfsLeaderState.from_wire(wire["directory"])
+            self._reserved = {k: int(v) for k, v in wire.get("reserved", {}).items()}
 
     # ---- RPC methods ---------------------------------------------------
 
@@ -238,6 +254,7 @@ class SdfsLeader:
         ``origin``. Returns {version, replicas}."""
         name, origin = p["name"], p["origin"]
         with self._lock:
+            self._require_leading()
             version = max(self.state.latest_version(name), self._reserved.get(name, 0)) + 1
             self._reserved[name] = version
         replicas = self._place(
@@ -272,10 +289,27 @@ class SdfsLeader:
             out = {v: self.state.replicas_of(name, v) for v in wanted}
         return {"versions": {str(v): rs for v, rs in out.items() if rs}}
 
+    def _record(self, p: dict) -> dict:
+        """Record an out-of-band replica (e.g. `train` broadcast pulls) in
+        the directory so ls/delete/healing see those copies too."""
+        with self._lock:
+            self._require_leading()
+            self.state.record(p["name"], int(p["version"]), p["member"])
+        return {}
+
     def _delete(self, p: dict) -> dict:
         name = p["name"]
         with self._lock:
-            members = sorted(self.state.directory.pop(name, {}))
+            self._require_leading()
+            entry = self.state.directory.pop(name, {})
+            members = sorted(entry)
+            # Reservation pruning, guarded against an in-flight put: a live
+            # reservation is strictly newer than anything in the directory,
+            # and dropping it would let the next put reuse that version
+            # number for different bytes.
+            latest = max((v for vs in entry.values() for v in vs), default=0)
+            if self._reserved.get(name, 0) <= latest:
+                self._reserved.pop(name, None)
         failed = []
         for m in members:
             try:

@@ -102,6 +102,23 @@ class InferenceEngine:
     def input_size(self) -> int:
         return self.spec.input_size
 
+    def load_variables(self, variables) -> None:
+        """Hot-swap the model weights (the member side of the `train` verb,
+        reference services.rs:139-144 + 513-524). The new tree must match the
+        compiled program's structure; it is re-sharded onto the mesh with the
+        same rules, so the jitted forward is reused without recompilation."""
+        old = jax.tree_util.tree_flatten_with_path(self.variables)
+        new = jax.tree_util.tree_flatten_with_path(variables)
+        if old[1] != new[1]:
+            raise ValueError(f"variables tree mismatch: {new[1]} != compiled {old[1]}")
+        for (path, cur), (_, nxt) in zip(old[0], new[0]):
+            if tuple(cur.shape) != tuple(np.shape(nxt)):
+                raise ValueError(
+                    f"shape mismatch at {jax.tree_util.keystr(path)}: "
+                    f"got {tuple(np.shape(nxt))}, compiled {tuple(cur.shape)}"
+                )
+        self.variables = mesh_lib.shard_params(self.mesh, variables)
+
     def warmup(self) -> float:
         """Compile with a zero batch; returns compile+first-run seconds."""
         t0 = time.perf_counter()

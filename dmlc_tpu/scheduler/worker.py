@@ -93,3 +93,50 @@ class EngineBackend:
                 result = engine.run_paths(paths[i : i + self.batch_size])
                 preds.extend(int(x) for x in result.top1_index)
             return preds
+
+    def load_variables(self, variables) -> None:
+        """Swap pretrained weights into the live engine (member side of the
+        `train` verb — the reference reloads .ot files, services.rs:513-524)."""
+        with self._lock:
+            self._ensure_engine().load_variables(variables)
+
+
+class ModelLoader:
+    """Member RPC surface for hot-loading distributed weights.
+
+    After `train` replicates ``models/{model}`` into a member's local SDFS
+    store, the leader calls ``model.load`` here: read the blob from the local
+    store, deserialize + validate (models/weights.py), and hand the variables
+    to the model's backend. Backends without ``load_variables`` (test fakes)
+    refuse cleanly.
+    """
+
+    def __init__(self, store, backends: dict):
+        self.store = store
+        self.backends = backends
+
+    def methods(self) -> dict:
+        return {"model.load": self._load}
+
+    def _load(self, p: dict) -> dict:
+        from dmlc_tpu.models import weights as weights_lib
+
+        model = p["model"]
+        backend = self.backends.get(model)
+        if backend is None:
+            raise RpcError(f"model {model!r} not served here")
+        if not hasattr(backend, "load_variables"):
+            raise RpcError(f"backend for {model!r} does not support weight loading")
+        name = weights_lib.sdfs_weights_name(model)
+        version = int(p["version"])
+        try:
+            blob = self.store.read(name, version)
+        except KeyError as e:
+            raise RpcError(str(e))
+        try:
+            _, variables = weights_lib.weights_from_bytes(blob, expect_model=model)
+        except ValueError as e:
+            raise RpcError(f"bad weights blob {name} v{version}: {e}")
+        backend.load_variables(variables)
+        log.info("loaded %s v%d into %s backend", name, version, model)
+        return {"model": model, "version": version}
