@@ -168,7 +168,8 @@ class ClusterNode:
         if self.is_candidate:
             self._spawn(self._heal_loop)
             self._spawn(self._assign_loop)
-            self._spawn(self._dispatch_loop)
+            for _ in range(max(1, self.config.dispatch_workers)):
+                self._spawn(self._dispatch_loop)
             self._spawn(self._standby_loop)
 
     def _spawn(self, fn) -> None:
@@ -216,9 +217,17 @@ class ClusterNode:
         )
 
     def _dispatch_loop(self):
+        """One dispatcher worker. config.dispatch_workers of these run
+        concurrently; each blocks on one shard RPC at a time, so together
+        they keep up to W shards in flight across the assigned members
+        (the scheduler's offset reservation makes this safe)."""
+
         def body():
-            if self.standby.is_leader and self.scheduler.dispatch_all_once() > 0:
-                return  # more work queued: loop immediately, no sleep
+            if self.standby.is_leader and self.scheduler.has_dispatchable():
+                if self.scheduler.dispatch_all_once() > 0:
+                    return  # progress made: loop immediately, no sleep
+            # Idle or failing (e.g. every assigned member erroring): back
+            # off so retries don't become a zero-sleep RPC flood.
             self._stop.wait(0.05)
 
         while not self._stop.is_set():
@@ -247,7 +256,12 @@ class ClusterNode:
         through SDFS (services.rs:139-144) — each member pulls the latest
         weights file for each job model and hot-swaps it into its running
         engine (the reference loads .ot files, services.rs:513-524). Pulled
-        copies are recorded in the leader directory so ls/delete see them."""
+        copies are recorded in the leader directory so ls/delete see them.
+        Members are driven concurrently (bounded by rpc_concurrency, the
+        reference's 10-way fanout, main.rs:61) so one wedged member delays
+        the verb by one timeout, not one timeout per member behind it."""
+        import concurrent.futures
+
         results = {}
         for name in self.config.job_models:
             sdfs_name = f"models/{name}"
@@ -260,23 +274,20 @@ class ClusterNode:
                 log.warning("train: no weights for %s: %s", sdfs_name, e)
                 continue
             have = set(info["replicas"])
-            for member in self.active_member_addrs():
+
+            def push_one(member: str) -> None:
                 if member not in have:  # existing replicas skip the re-transfer
-                    try:
-                        self.rpc.call(
-                            member,
-                            "sdfs.replicate",
-                            {
-                                "name": sdfs_name,
-                                "version": info["version"],
-                                "source": info["replicas"][0],
-                                "from_stage": False,
-                            },
-                        )
-                        pulled.append(member)
-                    except Exception as e:
-                        log.warning("train: %s -> %s: %s", sdfs_name, member, e)
-                        continue
+                    self.rpc.call(
+                        member,
+                        "sdfs.replicate",
+                        {
+                            "name": sdfs_name,
+                            "version": info["version"],
+                            "source": info["replicas"][0],
+                            "from_stage": False,
+                        },
+                    )
+                    pulled.append(member)
                     try:
                         self.rpc.call(
                             self.tracker.current,
@@ -285,16 +296,25 @@ class ClusterNode:
                         )
                     except Exception as e:
                         log.warning("train: record %s@%s: %s", sdfs_name, member, e)
-                try:
-                    self.rpc.call(
-                        member,
-                        "model.load",
-                        {"model": name, "version": info["version"]},
-                        timeout=120.0,
-                    )
-                    loaded.append(member)
-                except Exception as e:
-                    log.warning("train: load %s on %s: %s", name, member, e)
+                self.rpc.call(
+                    member,
+                    "model.load",
+                    {"model": name, "version": info["version"]},
+                    timeout=120.0,
+                )
+                loaded.append(member)
+
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=max(1, self.config.rpc_concurrency)
+            ) as pool:
+                futures = {
+                    pool.submit(push_one, m): m for m in self.active_member_addrs()
+                }
+                for fut, member in futures.items():
+                    try:
+                        fut.result()
+                    except Exception as e:
+                        log.warning("train: %s -> %s: %s", sdfs_name, member, e)
         return results
 
     def predict(self) -> dict:

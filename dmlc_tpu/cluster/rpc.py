@@ -95,7 +95,15 @@ class SimRpcNetwork(Rpc):
             raise RpcUnreachable(f"{source} is down")
         if addr in self.down or addr not in self.services or (source, addr) in self.cut:
             raise RpcUnreachable(f"{addr} unreachable from {source}")
-        return _dispatch(self.services[addr], method, payload)
+        try:
+            return _dispatch(self.services[addr], method, payload)
+        except RpcError:
+            raise
+        except Exception as e:
+            # Fidelity with the TCP fabric: a crashed method arrives at the
+            # caller as a remote RpcError (TcpRpcServer._serve_conn), never
+            # as the raw exception on the caller's stack.
+            raise RpcError(f"{type(e).__name__}: {e}") from e
 
 
 class SimRpcClient(Rpc):

@@ -21,6 +21,8 @@ mismatched blob fails at load, not mid-forward.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -37,9 +39,10 @@ def sdfs_weights_name(model_name: str) -> str:
     return f"models/{model_name}"
 
 
+@functools.lru_cache(maxsize=None)
 def variables_template(model_name: str):
     """Abstract (ShapeDtypeStruct) variables tree for a registry model —
-    no compilation, instant even for ViT-L."""
+    no compilation, and cached: every model.load RPC validates against it."""
     spec = get_model(model_name)
     model = spec.module(dtype=jnp.float32)
     dummy = jnp.zeros((1, spec.input_size, spec.input_size, 3), jnp.float32)

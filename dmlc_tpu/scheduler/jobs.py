@@ -19,6 +19,15 @@ target is reachable at all (the reference's 1-image-per-0.5 s tick caps at
 2 qps/job, services.rs:408). Shards are handed out round-robin over the
 job's assigned members; correctness is judged on the leader against the
 synset order of synset_words.txt (services.rs:170-184).
+
+Concurrency model: many dispatcher threads call ``dispatch_once``
+simultaneously (the reference fired queries fire-and-forget,
+services.rs:418-421); each call reserves a distinct shard offset under the
+lock, blocks on its member's RPC, then records the result. Results may
+arrive out of order, so they buffer per-offset and only a *contiguous
+prefix* is counted into ``finished`` — the durable cursor the standby
+leaders replicate. Failed shards requeue with the failed member excluded;
+a shard raced to two members counts exactly once (offset-keyed dedup).
 """
 
 from __future__ import annotations
@@ -40,17 +49,30 @@ class Job:
 
     model_name: str
     queries: list[tuple[str, int]]  # (synset_id, true_class_index)
-    finished: int = 0
+    finished: int = 0               # contiguous-prefix cursor (replicated)
     correct: int = 0
     running: bool = False
     assigned: list[str] = field(default_factory=list)
     query_stats: LatencyStats = field(default_factory=LatencyStats)
     shard_stats: LatencyStats = field(default_factory=LatencyStats)
     _next_member: int = 0
+    # --- in-flight bookkeeping (leader-local, never replicated) ---------
+    next_offset: int = 0                      # reservation cursor
+    outstanding: dict = field(default_factory=dict)   # offset -> member
+    buffered: dict = field(default_factory=dict)      # offset -> (preds, elapsed)
+    retry_q: list = field(default_factory=list)       # [(offset, excluded members)]
 
     @property
     def done(self) -> bool:
         return self.finished >= len(self.queries)
+
+    def reset_inflight(self) -> None:
+        """Drop all in-flight bookkeeping back to the durable cursor (after
+        adopting replicated state, or on resume)."""
+        self.next_offset = self.finished
+        self.outstanding.clear()
+        self.buffered.clear()
+        self.retry_q.clear()
 
     @property
     def accuracy(self) -> float:
@@ -86,6 +108,7 @@ class Job:
         self.running = bool(w["running"])
         self.query_stats = LatencyStats.from_wire(w["query_samples"])
         self.shard_stats = LatencyStats.from_wire(w["shard_samples"])
+        self.reset_inflight()
 
 
 class JobScheduler:
@@ -144,6 +167,10 @@ class JobScheduler:
             for job in self.jobs.values():
                 if not job.done:
                     job.running = True
+                    # A fresh leadership term resumes from the durable
+                    # cursor; in-flight work from a dead term is abandoned
+                    # (re-dispatched shards dedup by offset anyway).
+                    job.next_offset = max(job.next_offset, job.finished)
         self.assign_once()
         return {"jobs": sorted(self.jobs)}
 
@@ -179,26 +206,40 @@ class JobScheduler:
 
     # ---- dispatch (services.rs:407-433, shard-ized) --------------------
 
-    def next_shard(self, job_name: str) -> tuple[str, list[tuple[str, int]]] | None:
-        """Reserve the next shard and pick its member (round-robin). Returns
-        (member, queries) or None if the job is idle/starved/done."""
+    def next_shard(self, job_name: str):
+        """Reserve the next shard (retries first) and pick its member.
+        Returns (member, offset, queries, excluded_members) or None if the
+        job is idle/starved/done-reserving. Safe under concurrent callers:
+        each reservation hands out a distinct offset."""
         with self._lock:
             job = self.jobs[job_name]
-            if not job.running or job.done or not job.assigned:
+            if not job.running or not job.assigned:
                 return None
-            shard = job.queries[job.finished : job.finished + self.shard_size]
-            member = job.assigned[job._next_member % len(job.assigned)]
+            excluded: set = set()
+            if job.retry_q:
+                offset, excluded = job.retry_q.pop(0)
+            elif job.next_offset < len(job.queries):
+                offset = job.next_offset
+                job.next_offset += self.shard_size
+            else:
+                return None
+            shard = job.queries[offset : offset + self.shard_size]
+            pool = [m for m in job.assigned if m not in excluded] or job.assigned
+            member = pool[job._next_member % len(pool)]
             job._next_member += 1
-            return member, shard
+            job.outstanding[offset] = member
+            return member, offset, shard, excluded
 
     def dispatch_once(self, job_name: str) -> int:
-        """Send one shard, record results. Returns #queries completed (0 on
-        member failure — the shard stays at the cursor and the next pass
-        retries it on another member, so nothing is lost or double-counted)."""
+        """Send one shard, record its result. Returns the #queries newly
+        counted into the contiguous prefix by THIS call (an out-of-order
+        success returns 0 now; the call that fills the gap flushes it).
+        Failures requeue the shard with the member excluded — nothing is
+        ever lost or double-counted."""
         picked = self.next_shard(job_name)
         if picked is None:
             return 0
-        member, shard = picked
+        member, offset, shard, excluded = picked
         job = self.jobs[job_name]
         synsets = [s for s, _ in shard]
         t0 = self.timer()
@@ -209,33 +250,61 @@ class JobScheduler:
                     "job.predict",
                     {"model": job.model_name, "synsets": synsets},
                     # One shard is one batched forward: seconds. A bounded
-                    # timeout keeps a wedged member from stalling every job
-                    # for the reference's 1 h deadline (main.rs:132); on
-                    # expiry the shard retries on the next assigned member.
+                    # timeout keeps a wedged member from stalling the
+                    # dispatcher for the reference's 1 h deadline
+                    # (main.rs:132); on expiry the shard retries on the
+                    # next assigned member.
                     timeout=self.shard_timeout_s,
                 )
+            preds = list(reply["predictions"])
+            if len(preds) != len(shard):
+                raise RpcError(f"{len(preds)} predictions for {len(shard)} queries")
         except (RpcUnreachable, RpcError) as e:
-            log.warning("shard dispatch %s -> %s failed: %s", job_name, member, e)
+            log.warning("shard dispatch %s[%d] -> %s failed: %s", job_name, offset, member, e)
+            with self._lock:
+                job.outstanding.pop(offset, None)
+                if offset >= job.finished and offset not in job.buffered:
+                    job.retry_q.append((offset, excluded | {member}))
             return 0
         elapsed = self.timer() - t0
-        preds = reply["predictions"]
-        if len(preds) != len(shard):
-            log.warning("%s returned %d predictions for %d queries", member, len(preds), len(shard))
-            return 0
+        return self._record_result(job, offset, shard, preds, elapsed)
+
+    def _record_result(self, job: Job, offset: int, shard, preds, elapsed: float) -> int:
+        """Buffer one shard result; flush the contiguous prefix. Returns
+        #queries flushed by this call."""
         with self._lock:
-            if job.queries[job.finished : job.finished + len(shard)] != shard:
-                return 0  # lost a race with a concurrent dispatcher; drop
-            job.finished += len(shard)
-            job.correct += sum(1 for (_, truth), p in zip(shard, preds) if int(p) == truth)
-            job.shard_stats.record(elapsed)
-            job.query_stats.record_many(elapsed / len(shard), len(shard))
+            job.outstanding.pop(offset, None)
+            if offset < job.finished or offset in job.buffered:
+                return 0  # duplicate (shard raced to two members)
+            job.buffered[offset] = (preds, elapsed)
+            flushed = 0
+            while job.finished in job.buffered:
+                p, dt = job.buffered.pop(job.finished)
+                s = job.queries[job.finished : job.finished + len(p)]
+                job.finished += len(s)
+                job.correct += sum(1 for (_, truth), pred in zip(s, p) if int(pred) == truth)
+                job.shard_stats.record(dt)
+                job.query_stats.record_many(dt / max(1, len(s)), len(s))
+                flushed += len(s)
             if job.done:
                 job.running = False
-        return len(shard)
+                job.reset_inflight()
+            return flushed
 
     def dispatch_all_once(self) -> int:
-        """One pass over every running job. Returns total queries completed."""
+        """One pass over every running job. Returns total queries flushed."""
         return sum(self.dispatch_once(name) for name in sorted(self.jobs))
+
+    def has_dispatchable(self) -> bool:
+        """Any job with reservable work right now? (Cheap idle check for
+        dispatcher threads.)"""
+        with self._lock:
+            return any(
+                j.running
+                and j.assigned
+                and (j.retry_q or j.next_offset < len(j.queries))
+                for j in self.jobs.values()
+            )
 
     def run_to_completion(self, max_rounds: int = 100_000) -> None:
         """Drive all running jobs until done (used by tests and the CLI's

@@ -46,6 +46,10 @@ class ClusterConfig:
     # TPU-native dispatch is shard-based; this is the *shard* size per dispatch.
     dispatch_shard_size: int = 64
     rpc_concurrency: int = 10           # src/main.rs:61,79
+    # Dispatcher threads per leader: max shards in flight across all jobs
+    # (the reference dispatched fire-and-forget, services.rs:418-421; here
+    # in-flight work is bounded and tracked per shard offset).
+    dispatch_workers: int = 8
 
     # --- inference engine ---
     batch_size: int = 256

@@ -260,3 +260,183 @@ def test_standby_mirrors_sdfs_directory(tmp_path):
     v, data = client.get_bytes("w")
     assert (v, data) == (2, b"v2")
     assert client.put_bytes(b"v3", "w")["version"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Concurrent dispatch (round-2: up to W shards in flight per job)
+# ---------------------------------------------------------------------------
+
+import threading
+import time as _time
+
+
+def _sim_members(net, live, backend):
+    for m in live:
+        net.serve(m, PredictWorker({"j": backend}).methods())
+
+
+def echo_backend(synsets):
+    return [int(s[1:]) for s in synsets]
+
+
+def test_concurrent_dispatch_k_shards_in_flight():
+    """4 dispatcher threads drive 4 members SIMULTANEOUSLY: every backend
+    blocks on a barrier that only releases once all 4 have a shard in
+    flight — completion is proof of 4-way concurrency, no timing needed."""
+    net = SimRpcNetwork()
+    live = [f"m{i}" for i in range(4)]
+    barrier = threading.Barrier(4, timeout=10)
+
+    def backend(synsets):
+        barrier.wait()
+        return echo_backend(synsets)
+
+    _sim_members(net, live, backend)
+    sched = JobScheduler(
+        net.client("L"), lambda: list(live), jobs={"j": make_workload(64)}, shard_size=16
+    )
+    sched.is_leading = True
+    sched._start({})
+    threads = [threading.Thread(target=sched.dispatch_all_once) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=15)
+    job = sched.jobs["j"]
+    assert job.finished == 64 and job.correct == 64 and job.done
+    assert not job.outstanding and not job.buffered and not job.retry_q
+
+
+def test_concurrent_dispatch_completion_rate_scales():
+    """K members x W workers with per-shard latency: wall time ~ serial/K."""
+    net = SimRpcNetwork()
+    live = [f"m{i}" for i in range(4)]
+    delay = 0.03
+
+    def backend(synsets):
+        _time.sleep(delay)
+        return echo_backend(synsets)
+
+    _sim_members(net, live, backend)
+    n_shards, shard = 16, 8
+    sched = JobScheduler(
+        net.client("L"),
+        lambda: list(live),
+        jobs={"j": make_workload(n_shards * shard)},
+        shard_size=shard,
+    )
+    sched.is_leading = True
+    sched._start({})
+
+    def worker():
+        while sched.has_dispatchable() or sched.jobs["j"].running:
+            if sched.dispatch_all_once() == 0 and not sched.jobs["j"].running:
+                return
+
+    t0 = _time.perf_counter()
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    wall = _time.perf_counter() - t0
+    serial = n_shards * delay
+    job = sched.jobs["j"]
+    assert job.finished == n_shards * shard and job.correct == job.finished
+    assert wall < serial * 0.6, f"no speedup: wall={wall:.3f}s vs serial={serial:.3f}s"
+
+
+def test_out_of_order_results_flush_as_contiguous_prefix():
+    """Shard 0 completes AFTER shard 1: shard 1 buffers (finished stays 0,
+    the durable cursor never skips a gap), then shard 0 flushes both."""
+    net = SimRpcNetwork()
+    gate = threading.Event()
+
+    def slow(synsets):
+        assert gate.wait(10)
+        return echo_backend(synsets)
+
+    net.serve("m0", PredictWorker({"j": slow}).methods())
+    net.serve("m1", PredictWorker({"j": echo_backend}).methods())
+    sched = JobScheduler(
+        net.client("L"), lambda: ["m0", "m1"], jobs={"j": make_workload(16)}, shard_size=8
+    )
+    sched.is_leading = True
+    sched._start({})
+    job = sched.jobs["j"]
+    assert job.assigned == ["m0", "m1"]
+
+    t = threading.Thread(target=sched.dispatch_once, args=("j",))
+    t.start()  # reserves offset 0 -> m0 (round-robin), blocks on the gate
+    deadline = _time.monotonic() + 10
+    while 0 not in job.outstanding and _time.monotonic() < deadline:
+        _time.sleep(0.005)
+    assert job.outstanding.get(0) == "m0"
+
+    flushed = sched.dispatch_once("j")  # offset 8 -> m1, completes first
+    assert flushed == 0  # buffered: the gap at offset 0 is still open
+    assert job.finished == 0 and 8 in job.buffered
+
+    gate.set()
+    t.join(timeout=10)
+    assert job.finished == 16 and job.correct == 16 and job.done
+
+
+def test_failed_shard_retries_excluding_failed_member():
+    net = SimRpcNetwork()
+
+    def broken(synsets):
+        raise RuntimeError("wedged accelerator")
+
+    net.serve("m0", PredictWorker({"j": broken}).methods())
+    net.serve("m1", PredictWorker({"j": echo_backend}).methods())
+    sched = JobScheduler(
+        net.client("L"), lambda: ["m0", "m1"], jobs={"j": make_workload(8)}, shard_size=8
+    )
+    sched.is_leading = True
+    sched._start({})
+    assert sched.dispatch_once("j") == 0  # m0 fails the shard
+    job = sched.jobs["j"]
+    assert job.retry_q and job.retry_q[0][0] == 0 and "m0" in job.retry_q[0][1]
+    assert sched.dispatch_once("j") == 8  # retried on m1, not m0
+    assert job.finished == 8 and job.correct == 8
+
+
+def test_concurrent_crash_mid_run_keeps_exactly_once():
+    """Members crash while 4 dispatcher threads are in flight: every query
+    still counts exactly once."""
+    net = SimRpcNetwork()
+    live = [f"m{i}" for i in range(4)]
+
+    def backend(synsets):
+        _time.sleep(0.002)
+        return echo_backend(synsets)
+
+    _sim_members(net, live, backend)
+    total = 64 * 8
+    sched = JobScheduler(
+        net.client("L"), lambda: list(live), jobs={"j": make_workload(total)}, shard_size=8
+    )
+    sched.is_leading = True
+    sched._start({})
+
+    def worker():
+        while True:
+            sched.assign_once()
+            if sched.dispatch_all_once() == 0 and not sched.jobs["j"].running:
+                return
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    _time.sleep(0.05)
+    net.crash("m2")
+    live.remove("m2")
+    _time.sleep(0.05)
+    net.crash("m0")
+    live.remove("m0")
+    for t in threads:
+        t.join(timeout=30)
+    job = sched.jobs["j"]
+    assert job.finished == total
+    assert job.correct == total  # exactly once: no double counts, no losses
