@@ -231,11 +231,12 @@ class JobScheduler:
             return member, offset, shard, excluded
 
     def dispatch_once(self, job_name: str) -> int:
-        """Send one shard, record its result. Returns the #queries newly
-        counted into the contiguous prefix by THIS call (an out-of-order
-        success returns 0 now; the call that fills the gap flushes it).
-        Failures requeue the shard with the member excluded — nothing is
-        ever lost or double-counted."""
+        """Send one shard, record its result. Returns the #queries this call
+        COMPLETED (0 on failure or duplicate) — an out-of-order success
+        buffers its result and still counts as completed work; the contiguous
+        ``finished`` cursor advances only when the gap fills. Failures
+        requeue the shard with the member excluded — nothing is ever lost or
+        double-counted."""
         picked = self.next_shard(job_name)
         if picked is None:
             return 0
@@ -271,13 +272,12 @@ class JobScheduler:
 
     def _record_result(self, job: Job, offset: int, shard, preds, elapsed: float) -> int:
         """Buffer one shard result; flush the contiguous prefix. Returns
-        #queries flushed by this call."""
+        #queries completed by this call (len(shard), or 0 for a duplicate)."""
         with self._lock:
             job.outstanding.pop(offset, None)
             if offset < job.finished or offset in job.buffered:
                 return 0  # duplicate (shard raced to two members)
             job.buffered[offset] = (preds, elapsed)
-            flushed = 0
             while job.finished in job.buffered:
                 p, dt = job.buffered.pop(job.finished)
                 s = job.queries[job.finished : job.finished + len(p)]
@@ -285,14 +285,13 @@ class JobScheduler:
                 job.correct += sum(1 for (_, truth), pred in zip(s, p) if int(pred) == truth)
                 job.shard_stats.record(dt)
                 job.query_stats.record_many(dt / max(1, len(s)), len(s))
-                flushed += len(s)
             if job.done:
                 job.running = False
                 job.reset_inflight()
-            return flushed
+            return len(shard)
 
     def dispatch_all_once(self) -> int:
-        """One pass over every running job. Returns total queries flushed."""
+        """One pass over every running job. Returns total queries completed."""
         return sum(self.dispatch_once(name) for name in sorted(self.jobs))
 
     def has_dispatchable(self) -> bool:

@@ -88,11 +88,13 @@ class EngineBackend:
         with self._lock:
             engine = self._ensure_engine()
             paths = [pp.class_image_path(self.data_dir, s) for s in synsets]
-            preds: list[int] = []
-            for i in range(0, len(paths), self.batch_size):
-                result = engine.run_paths(paths[i : i + self.batch_size])
-                preds.extend(int(x) for x in result.top1_index)
-            return preds
+            if len(paths) <= self.batch_size:
+                result = engine.run_paths(paths)
+            else:
+                # Multi-batch shard: decode batch i+1 while the device runs
+                # batch i (SURVEY §7 hard part b).
+                result = engine.run_paths_stream(paths)
+            return [int(x) for x in result.top1_index]
 
     def load_variables(self, variables) -> None:
         """Swap pretrained weights into the live engine (member side of the

@@ -1,0 +1,64 @@
+"""Synthetic evaluation corpus: the reference's fixture-dataset shape.
+
+The reference ships ``test_files/imagenet_1k/train/<synset>/<one JPEG>`` (one
+image per each of 1,000 classes) plus ``synset_words.txt`` mapping synset ids
+to labels (src/services.rs:170-184, 485-490). That corpus is not
+redistributable here, so this module *generates* one with the same layout:
+deterministic random JPEGs, one directory per synthetic synset. It powers the
+end-to-end (JPEG -> top-1) bench mode and any test that wants a real
+decode-from-disk path without shipping binary fixtures.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+
+def write_synset_words(path: str | Path, n_classes: int) -> Path:
+    """``synset_words.txt`` with synthetic ids n00000000..: one per class."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("".join(f"n{i:08d} synthetic class {i}\n" for i in range(n_classes)))
+    return path
+
+
+def generate(
+    root: str | Path,
+    n_classes: int = 100,
+    images_per_class: int = 1,
+    size: int = 256,
+    seed: int = 0,
+    quality: int = 90,
+) -> tuple[Path, Path]:
+    """Create the corpus under ``root``; returns (data_dir, synset_path).
+
+    Layout: ``root/train/n{i:08d}/img{j}.jpg`` + ``root/synset_words.txt``.
+    Images are smooth random fields (not pure noise) so JPEG encode/decode
+    behaves like photographs rather than degenerate high-entropy blocks.
+    Existing corpora with the right shape are reused, not regenerated.
+    """
+    from PIL import Image
+
+    root = Path(root)
+    data_dir = root / "train"
+    synset_path = root / "synset_words.txt"
+    if synset_path.exists() and data_dir.exists():
+        dirs = [d for d in data_dir.iterdir() if d.is_dir()]
+        if len(dirs) >= n_classes and all(any(d.iterdir()) for d in dirs[:n_classes]):
+            return data_dir, synset_path
+
+    write_synset_words(synset_path, n_classes)
+    rng = np.random.default_rng(seed)
+    low = max(8, size // 8)
+    for i in range(n_classes):
+        d = data_dir / f"n{i:08d}"
+        d.mkdir(parents=True, exist_ok=True)
+        for j in range(images_per_class):
+            # Low-frequency field upsampled to full size: photograph-like
+            # JPEG statistics at ~100x the encode speed of per-pixel noise.
+            base = rng.integers(0, 256, (low, low, 3), np.uint8)
+            im = Image.fromarray(base).resize((size, size), Image.BILINEAR)
+            im.save(d / f"img{j}.jpg", quality=quality)
+    return data_dir, synset_path

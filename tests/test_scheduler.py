@@ -373,9 +373,9 @@ def test_out_of_order_results_flush_as_contiguous_prefix():
         _time.sleep(0.005)
     assert job.outstanding.get(0) == "m0"
 
-    flushed = sched.dispatch_once("j")  # offset 8 -> m1, completes first
-    assert flushed == 0  # buffered: the gap at offset 0 is still open
-    assert job.finished == 0 and 8 in job.buffered
+    completed = sched.dispatch_once("j")  # offset 8 -> m1, completes first
+    assert completed == 8  # completed work, but buffered behind the gap:
+    assert job.finished == 0 and 8 in job.buffered  # cursor never skips
 
     gate.set()
     t.join(timeout=10)

@@ -1,0 +1,106 @@
+"""Decode/compute overlap: run_paths_stream parity + the synthetic corpus.
+
+SURVEY §7 hard part (b): at >10k img/s the JPEG decode must overlap with
+device transfer/compute. These tests pin the overlapped pipeline's
+*correctness* (identical results to the serial per-batch path, tail-batch
+padding, embedding models, pipeline really interleaves) on the CPU mesh;
+its throughput is measured by bench.py's e2e mode on hardware.
+"""
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.utils import corpus
+from tiny_model import N_CLASSES  # registers "tinynet"
+
+
+@pytest.fixture(scope="module")
+def small_corpus(tmp_path_factory):
+    root = tmp_path_factory.mktemp("corpus")
+    data_dir, synset_path = corpus.generate(
+        root, n_classes=12, images_per_class=2, size=48
+    )
+    paths = sorted(p for d in sorted(data_dir.iterdir()) for p in d.iterdir())
+    return data_dir, synset_path, paths
+
+
+def test_corpus_layout(small_corpus):
+    from dmlc_tpu.ops.preprocess import class_image_path, load_synset_words
+
+    data_dir, synset_path, paths = small_corpus
+    assert len(paths) == 24
+    words = load_synset_words(synset_path)
+    assert len(words) == 12
+    first = class_image_path(data_dir, words[0][0])
+    assert first.suffix == ".jpg"
+    # Regeneration is a no-op on an existing corpus.
+    again_dir, _ = corpus.generate(data_dir.parent, n_classes=12, images_per_class=2)
+    assert again_dir == data_dir
+
+
+def test_stream_matches_serial(small_corpus):
+    from dmlc_tpu.parallel.inference import InferenceEngine
+
+    _, _, paths = small_corpus
+    engine = InferenceEngine("tinynet", batch_size=8, seed=1)
+    # 24 images / batch 8 = 3 full batches; also slice to force a ragged tail.
+    for subset in (paths, paths[:19]):
+        serial_idx, serial_top = [], []
+        for s in range(0, len(subset), 8):
+            r = engine.run_paths(subset[s : s + 8])
+            serial_idx.extend(r.top1_index)
+            serial_top.extend(r.top1_prob)
+        stream = engine.run_paths_stream(subset)
+        assert len(stream.top1_index) == len(subset)
+        np.testing.assert_array_equal(stream.top1_index, serial_idx)
+        np.testing.assert_allclose(stream.top1_prob, serial_top, rtol=1e-6)
+
+
+def test_stream_embedding_model(small_corpus):
+    from dmlc_tpu.models import registry
+    from dmlc_tpu.parallel.inference import InferenceEngine
+    from tiny_model import TinyEmbed  # noqa: F401  (registers tinyembed)
+
+    _, _, paths = small_corpus
+    engine = InferenceEngine("tinyembed", batch_size=8, seed=2)
+    stream = engine.run_paths_stream(paths[:19])
+    assert stream.embeddings.shape == (19, 16)
+    serial = engine.run_paths(paths[:8])
+    np.testing.assert_allclose(stream.embeddings[:8], serial.embeddings, rtol=1e-6)
+
+
+def test_stream_actually_overlaps(small_corpus, monkeypatch):
+    """The decode of batch i+1 must start before batch i's result is
+    materialized — observed via span ordering on a slowed-down fake."""
+    import threading
+
+    from dmlc_tpu.parallel.inference import InferenceEngine
+    from dmlc_tpu.ops import preprocess as pp
+
+    _, _, paths = small_corpus
+    engine = InferenceEngine("tinynet", batch_size=8, seed=3)
+
+    events = []
+    lock = threading.Lock()
+    real_load = pp.load_batch
+
+    def traced_load(ps, **kw):
+        with lock:
+            events.append("decode_start")
+        out = real_load(ps, **kw)
+        with lock:
+            events.append("decode_end")
+        return out
+
+    real_materialize = engine._materialize
+
+    def traced_materialize(n, out):
+        with lock:
+            events.append("materialize")
+        return real_materialize(n, out)
+
+    monkeypatch.setattr(pp, "load_batch", traced_load)
+    engine._materialize = traced_materialize
+    engine.run_paths_stream(paths)  # 3 batches
+    # With prefetch=2 the second decode starts before the first materialize.
+    assert events.index("decode_start", 1) < events.index("materialize")

@@ -14,41 +14,16 @@ A tiny registered model ("tinynet") keeps the real-JAX path fast on CPU.
 
 import random
 import time
-from typing import Any
 
-import flax.linen as nn
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from dmlc_tpu.models import registry
 from dmlc_tpu.models import weights as weights_lib
+from tiny_model import N_CLASSES
 
-N_CLASSES = 40
 TARGET_CLASS = 7
-
-
-class TinyNet(nn.Module):
-    num_classes: int = N_CLASSES
-    dtype: Any = jnp.bfloat16
-
-    @nn.compact
-    def __call__(self, x, train: bool = False):
-        x = x.astype(self.dtype)
-        x = nn.relu(nn.Conv(8, (3, 3), dtype=self.dtype, param_dtype=jnp.float32, name="conv1")(x))
-        x = jnp.mean(x, axis=(1, 2))
-        x = nn.Dense(self.num_classes, dtype=self.dtype, param_dtype=jnp.float32, name="head")(x)
-        return x.astype(jnp.float32)
-
-
-def tinynet(num_classes: int = N_CLASSES, dtype: Any = jnp.bfloat16) -> TinyNet:
-    return TinyNet(num_classes=num_classes, dtype=dtype)
-
-
-registry.register(
-    registry.ModelSpec("tinynet", tinynet, input_size=32, num_outputs=N_CLASSES)
-)
 
 
 def constant_prediction_variables(target: int = TARGET_CLASS):

@@ -1,0 +1,57 @@
+"""Shared test fixture: a tiny registered model ("tinynet") so real-JAX
+paths (engine, weights loop, stream pipeline) stay fast on the CPU mesh."""
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from dmlc_tpu.models import registry
+
+N_CLASSES = 40
+
+
+class TinyNet(nn.Module):
+    num_classes: int = N_CLASSES
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(8, (3, 3), dtype=self.dtype, param_dtype=jnp.float32, name="conv1")(x))
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, param_dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def tinynet(num_classes: int = N_CLASSES, dtype: Any = jnp.bfloat16) -> TinyNet:
+    return TinyNet(num_classes=num_classes, dtype=dtype)
+
+
+class TinyEmbed(nn.Module):
+    """Embedding-model fixture (classifier=False path)."""
+
+    embed_dim: int = 16
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.embed_dim, dtype=self.dtype, param_dtype=jnp.float32, name="proj")(x)
+        return x.astype(jnp.float32)
+
+
+def tinyembed(dtype: Any = jnp.bfloat16) -> TinyEmbed:
+    return TinyEmbed(dtype=dtype)
+
+
+if "tinynet" not in registry.list_models():
+    registry.register(
+        registry.ModelSpec("tinynet", tinynet, input_size=32, num_outputs=N_CLASSES)
+    )
+    registry.register(
+        registry.ModelSpec(
+            "tinyembed", tinyembed, input_size=32, num_outputs=16, classifier=False
+        )
+    )
