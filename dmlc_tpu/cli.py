@@ -57,6 +57,7 @@ Commands (reference: README.md:10-23):
   store | s                             files stored on this node
   train | t                             broadcast model weights to members
   predict                               start/resume the inference jobs
+  mesh-join                             join the fleet-wide jax.distributed mesh
   jobs                                  job status, accuracy, latency percentiles
   assign                                per-job member assignment table
   help                                  this text
@@ -153,6 +154,12 @@ class Cli:
         if cmd == "predict":
             reply = n.predict()
             return f"started jobs: {', '.join(reply['jobs'])}"
+        if cmd == "mesh-join":
+            info = n.join_global_mesh()
+            return (
+                f"joined global mesh: process {info['process_id']}"
+                f"/{info['num_processes']}, coordinator {info['coordinator']}"
+            )
         if cmd == "jobs":
             out = []
             for name, r in sorted(n.jobs_report().items()):

@@ -84,6 +84,7 @@ class StandbyLeader:
         candidates: list[str],
         scheduler,
         sdfs_leader=None,
+        mesh_bootstrap=None,
         on_promote: Callable[[], None] | None = None,
     ):
         self.rpc = rpc
@@ -91,6 +92,7 @@ class StandbyLeader:
         self.candidates = list(candidates)
         self.scheduler = scheduler
         self.sdfs_leader = sdfs_leader
+        self.mesh_bootstrap = mesh_bootstrap
         self.on_promote = on_promote
         self.is_leader = False
 
@@ -135,6 +137,8 @@ class StandbyLeader:
         self.scheduler.is_leading = True
         if self.sdfs_leader is not None:
             self.sdfs_leader.is_leading = True
+        if self.mesh_bootstrap is not None:
+            self.mesh_bootstrap.is_leading = True
         log.warning("%s: promoting to leader", self.self_addr)
         if self.scheduler.has_history():
             # Resume interrupted jobs from the replicated cursor.

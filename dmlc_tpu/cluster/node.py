@@ -95,6 +95,7 @@ class ClusterNode:
         self.sdfs_leader = None
         self.scheduler = None
         self.standby = None
+        self.mesh_bootstrap = None
         if self.is_candidate:
             self._start_leader_services()
 
@@ -130,6 +131,15 @@ class ClusterNode:
             shard_size=self.config.dispatch_shard_size,
         )
         methods = {**self.sdfs_leader.methods(), **self.scheduler.methods()}
+        if self.config.mesh_processes > 1:
+            from dmlc_tpu.parallel.multihost import MeshBootstrap
+
+            self.mesh_bootstrap = MeshBootstrap(
+                self.config.mesh_coordinator_port,
+                self.config.mesh_processes,
+                is_leading=False,  # promoted with the rest by StandbyLeader
+            )
+            methods.update(self.mesh_bootstrap.methods())
         self.leader_server = TcpRpcServer(self.config.host, self.config.leader_port, methods)
         # Leadership is claimed via StandbyLeader.step(), never assumed at
         # boot: a restarted ex-leader must defer to whoever promoted while
@@ -140,6 +150,7 @@ class ClusterNode:
             self.leader_candidates,
             self.scheduler,
             sdfs_leader=self.sdfs_leader,
+            mesh_bootstrap=self.mesh_bootstrap,
         )
 
     # ---- liveness glue -------------------------------------------------
@@ -316,6 +327,18 @@ class ClusterNode:
                     except Exception as e:
                         log.warning("train: %s -> %s: %s", sdfs_name, member, e)
         return results
+
+    def join_global_mesh(self, timeout_s: float = 120.0) -> dict:
+        """Form/join the fleet-wide jax.distributed runtime via the elected
+        leader (config.mesh_processes processes -> ONE global device mesh).
+        Explicit, not automatic: initializing jax.distributed is
+        irreversible for the process, so the operator (or deploy script)
+        triggers it once the fleet is assembled."""
+        from dmlc_tpu.parallel import multihost
+
+        return multihost.join_global_mesh(
+            self.rpc, self.tracker.current, self.self_member_addr, timeout_s=timeout_s
+        )
 
     def predict(self) -> dict:
         return self.rpc.call(self.tracker.current, "job.start", {})

@@ -1,0 +1,150 @@
+"""Multi-host mesh formation: the cluster substrate bootstraps jax.distributed.
+
+The reference runs on 10 hosts but each host's model runs alone — there is no
+cross-host device mesh anywhere (src/services.rs:26-30, 199-211). TPU-native
+scaling needs one: a v5e-8 host is multi-chip, but anything bigger (pods,
+multi-host DP/TP) requires every process to join one jax.distributed runtime
+so ``jax.devices()`` becomes the GLOBAL device list and pjit/shard_map
+programs span hosts, with XLA routing collectives over ICI/DCN.
+
+The missing piece is agreeing on (coordinator_address, num_processes,
+process_id) — exactly the kind of agreement the cluster layer already
+provides. The elected leader (cluster/failover.py) serves ``mesh.register``:
+each member registers its address and is assigned the next process id;
+everyone polls until the expected process count has registered, then calls
+``jax.distributed.initialize`` with the leader-published coordinator address.
+Deterministic, restart-safe (same address re-registers to the same rank), and
+with no second consensus system.
+
+Hermetic coverage: tests/test_multihost.py forms a real 2-process CPU
+jax.distributed runtime and runs the dp train step over the global mesh.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from dmlc_tpu.cluster.rpc import Rpc, RpcError
+
+log = logging.getLogger(__name__)
+
+
+class MeshBootstrap:
+    """Leader-side rank assignment for the global device mesh.
+
+    Ranks are handed out in registration order; re-registration of a known
+    address is idempotent (a restarted process keeps its rank — required, as
+    jax.distributed binds rank to the coordinator's barrier state). The
+    published coordinator address is ``<rank-0's host>:<coordinator_port>``:
+    jax.distributed runs the coordination service IN process 0, so the
+    coordinator host must be wherever rank 0 lives, which is only known once
+    the first process registers.
+
+    Like SdfsLeader, writes are refused unless actively leading (set by
+    StandbyLeader on promotion) so two candidates can never hand out
+    conflicting rank maps. The mesh forms once per fleet lifetime — a
+    post-failover leader cannot re-rank already-initialized processes.
+    """
+
+    def __init__(self, coordinator_port: int, num_processes: int, is_leading: bool = True):
+        self.coordinator_port = int(coordinator_port)
+        self.num_processes = int(num_processes)
+        self.is_leading = is_leading
+        self.ranks: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def methods(self) -> dict:
+        return {"mesh.register": self._register, "mesh.info": self._info}
+
+    def _register(self, p: dict) -> dict:
+        addr = p["addr"]
+        with self._lock:
+            if not self.is_leading:
+                raise RpcError("not the active leader")
+            if addr not in self.ranks:
+                if len(self.ranks) >= self.num_processes:
+                    raise RpcError(
+                        f"mesh is full: {self.num_processes} processes already registered"
+                    )
+                self.ranks[addr] = len(self.ranks)
+            return self._info_locked(self.ranks[addr])
+
+    def _info(self, p: dict) -> dict:
+        with self._lock:
+            return self._info_locked(None)
+
+    def _coordinator_locked(self) -> str | None:
+        rank0 = next((a for a, r in self.ranks.items() if r == 0), None)
+        if rank0 is None:
+            return None
+        host, _, _ = rank0.rpartition(":")
+        return f"{host}:{self.coordinator_port}"
+
+    def _info_locked(self, process_id) -> dict:
+        return {
+            "process_id": process_id,
+            "num_processes": self.num_processes,
+            "coordinator": self._coordinator_locked(),
+            "registered": len(self.ranks),
+            "ready": len(self.ranks) >= self.num_processes,
+        }
+
+
+def register_until_ready(
+    rpc: Rpc,
+    leader_addr: str,
+    self_addr: str,
+    timeout_s: float = 120.0,
+    poll_s: float = 0.5,
+) -> dict:
+    """Register with the leader and poll until every expected process has —
+    returns the final {process_id, num_processes, coordinator, ...} info.
+    Transient leader failures (connection drops, a candidate still deferring
+    mid-election) keep polling until the deadline instead of aborting the
+    whole fleet's join."""
+    deadline = time.monotonic() + timeout_s
+    info = None
+    last_err: Exception | None = None
+    while time.monotonic() < deadline:
+        try:
+            info = rpc.call(leader_addr, "mesh.register", {"addr": self_addr})
+            if info["ready"]:
+                return info
+        except RpcError as e:
+            last_err = e
+            log.warning("mesh.register at %s failed (will retry): %s", leader_addr, e)
+        time.sleep(poll_s)
+    raise TimeoutError(
+        f"global mesh never became ready: {info and info['registered']}"
+        f"/{info and info['num_processes']} processes registered"
+        + (f" (last error: {last_err})" if last_err else "")
+    )
+
+
+def initialize_global_runtime(info: dict) -> None:
+    """Join the jax.distributed runtime described by a register reply. After
+    this, jax.devices() is the GLOBAL device list and meshes span hosts."""
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=info["coordinator"],
+        num_processes=int(info["num_processes"]),
+        process_id=int(info["process_id"]),
+    )
+    log.info(
+        "joined global mesh: process %d/%d, %d global devices",
+        info["process_id"],
+        info["num_processes"],
+        jax.device_count(),
+    )
+
+
+def join_global_mesh(
+    rpc: Rpc, leader_addr: str, self_addr: str, timeout_s: float = 120.0
+) -> dict:
+    """The member-side one-call path: register, wait for the fleet, join."""
+    info = register_until_ready(rpc, leader_addr, self_addr, timeout_s=timeout_s)
+    initialize_global_runtime(info)
+    return info

@@ -64,6 +64,13 @@ class ClusterConfig:
     # risks compile-time GIL holds starving the heartbeat threads.
     eager_load: bool = True
 
+    # --- multi-host global device mesh (parallel/multihost.py) ---
+    # >1 enables leader-coordinated jax.distributed bootstrap: members call
+    # node.join_global_mesh() and the process fleet forms ONE device mesh
+    # spanning hosts (collectives ride ICI/DCN). 1 = single-process meshes.
+    mesh_processes: int = 1
+    mesh_coordinator_port: int = 8853
+
     def with_updates(self, **kw) -> "ClusterConfig":
         return dataclasses.replace(self, **kw)
 

@@ -1,0 +1,183 @@
+"""Multi-host mesh formation: leader rank assignment + a REAL 2-process
+jax.distributed runtime on CPU running the dp train step over one global
+mesh (the BASELINE "distributed inference across nodes" configs in
+hermetic form — reference src/services.rs:26-30 fleet, redesigned as one
+device mesh instead of per-host silos)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from dmlc_tpu.cluster.rpc import RpcError, SimRpcNetwork
+from dmlc_tpu.parallel.multihost import MeshBootstrap, register_until_ready
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_rank_assignment_idempotent_and_bounded():
+    net = SimRpcNetwork()
+    boot = MeshBootstrap(coordinator_port=8853, num_processes=3)
+    net.serve("L", boot.methods())
+    cli = net.client("x")
+
+    a = cli.call("L", "mesh.register", {"addr": "hostA:1"})
+    b = cli.call("L", "mesh.register", {"addr": "hostB:1"})
+    assert (a["process_id"], b["process_id"]) == (0, 1)
+    assert not b["ready"] and b["registered"] == 2
+    # The coordinator lives where rank 0 lives (jax.distributed runs the
+    # coordination service in process 0).
+    assert b["coordinator"] == "hostA:8853"
+    # Re-registration (process restart) keeps the same rank.
+    again = cli.call("L", "mesh.register", {"addr": "hostA:1"})
+    assert again["process_id"] == 0 and again["registered"] == 2
+
+    c = cli.call("L", "mesh.register", {"addr": "hostC:1"})
+    assert c["process_id"] == 2 and c["ready"]
+    with pytest.raises(RpcError, match="full"):
+        cli.call("L", "mesh.register", {"addr": "hostD:1"})
+
+
+def test_register_refused_unless_leading():
+    net = SimRpcNetwork()
+    boot = MeshBootstrap(coordinator_port=8853, num_processes=2, is_leading=False)
+    net.serve("L", boot.methods())
+    with pytest.raises(RpcError, match="not the active leader"):
+        net.client("x").call("L", "mesh.register", {"addr": "hostA:1"})
+    boot.is_leading = True  # StandbyLeader._promote does this
+    assert net.client("x").call("L", "mesh.register", {"addr": "hostA:1"})["process_id"] == 0
+
+
+def test_register_until_ready_retries_transient_failures():
+    """A leader that is briefly unreachable mid-poll must not abort the
+    member's whole join window."""
+    import threading
+    import time
+
+    net = SimRpcNetwork()
+    boot = MeshBootstrap(coordinator_port=1, num_processes=2)
+    net.serve("L", boot.methods())
+    net.crash("L")  # leader restarting while the member starts polling
+
+    def recover():
+        time.sleep(0.1)
+        net.restart("L")
+        net.client("y").call("L", "mesh.register", {"addr": "hostB:1"})
+
+    t = threading.Thread(target=recover)
+    t.start()
+    info = register_until_ready(net.client("x"), "L", "hostA:1", timeout_s=5.0, poll_s=0.02)
+    t.join()
+    assert info["ready"]
+
+
+def test_register_until_ready_polls_to_quorum():
+    net = SimRpcNetwork()
+    boot = MeshBootstrap(coordinator_port=1, num_processes=2)
+    net.serve("L", boot.methods())
+    # Second process registers from a side thread after a delay.
+    import threading
+    import time
+
+    def late_joiner():
+        time.sleep(0.1)
+        net.client("y").call("L", "mesh.register", {"addr": "hostB:1"})
+
+    t = threading.Thread(target=late_joiner)
+    t.start()
+    info = register_until_ready(net.client("x"), "L", "hostA:1", timeout_s=5.0, poll_s=0.02)
+    t.join()
+    assert info["ready"] and info["process_id"] == 0
+
+
+WORKER = textwrap.dedent(
+    """
+    import json, sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    rank, coord = int(sys.argv[1]), sys.argv[2]
+    jax.distributed.initialize(coordinator_address=coord, num_processes=2, process_id=rank)
+    assert jax.device_count() == 2, f"global devices: {jax.device_count()}"
+    assert jax.local_device_count() == 1
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from dmlc_tpu.models.vit import ViT
+    from dmlc_tpu.parallel import mesh as mesh_lib
+    from dmlc_tpu.parallel import train as train_lib
+
+    mesh = mesh_lib.make_mesh({"dp": 2})  # spans both processes
+    tiny = ViT(num_classes=8, patch_size=8, hidden_size=32, num_layers=1,
+               num_heads=2, mlp_dim=64, dtype=jnp.float32)
+    images_local = np.random.RandomState(rank).randn(4, 16, 16, 3).astype(np.float32)
+    labels_local = (np.arange(4) + rank) % 8
+    variables = tiny.init(jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)), train=False)
+    state = train_lib.create_train_state(tiny, variables, train_lib.default_optimizer(1e-3))
+    state, step_fn = train_lib.make_train_step(mesh, state)
+
+    data_shd = NamedSharding(mesh, P("dp"))
+    images = jax.make_array_from_process_local_data(data_shd, images_local)
+    labels = jax.make_array_from_process_local_data(data_shd, labels_local)
+    state, metrics = step_fn(state, images, labels)
+    state, metrics = step_fn(state, images, labels)
+    loss = float(metrics["loss"])
+    print(json.dumps({"rank": rank, "loss": loss, "step": int(state.step)}), flush=True)
+    """
+)
+
+
+def test_two_process_global_mesh_train_step(tmp_path):
+    """Two OS processes -> one jax.distributed runtime -> one 2-device dp
+    mesh -> two SPMD train steps. Both processes must agree on the loss
+    (the gradient psum crossed the process boundary)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # 1 local device per process
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(rank), coord],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+            cwd=REPO_ROOT,
+            text=True,
+        )
+        for rank in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+
+    assert {o["rank"] for o in outs} == {0, 1}
+    assert all(o["step"] == 2 for o in outs)
+    losses = [o["loss"] for o in outs]
+    assert losses[0] == pytest.approx(losses[1], rel=1e-6)
+    assert np_finite(losses[0])
+
+
+def np_finite(x) -> bool:
+    import numpy as np
+
+    return bool(np.isfinite(x))
