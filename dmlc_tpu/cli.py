@@ -57,6 +57,7 @@ Commands (reference: README.md:10-23):
   store | s                             files stored on this node
   train | t                             broadcast model weights to members
   predict                               start/resume the inference jobs
+  export <model>                        publish the model's StableHLO executable
   mesh-join                             join the fleet-wide jax.distributed mesh
   jobs                                  job status, accuracy, latency percentiles
   assign                                per-job member assignment table
@@ -154,6 +155,15 @@ class Cli:
         if cmd == "predict":
             reply = n.predict()
             return f"started jobs: {', '.join(reply['jobs'])}"
+        if cmd == "export":
+            if len(args) != 1:
+                return "usage: export <model_name>"
+            from dmlc_tpu.models import export as export_lib
+
+            v = export_lib.publish_executable(
+                n.sdfs, args[0], batch_size=n.config.batch_size
+            )
+            return f"exported {args[0]} -> {export_lib.sdfs_executable_name(args[0])} v{v}"
         if cmd == "mesh-join":
             info = n.join_global_mesh()
             return (

@@ -129,6 +129,9 @@ class StandbyLeader:
             if self.sdfs_leader is not None:
                 wire = self.rpc.call(addr, "sdfs.state", {}, timeout=2.0)
                 self.sdfs_leader.adopt_state(wire)
+            if self.mesh_bootstrap is not None:
+                wire = self.rpc.call(addr, "mesh.state", {}, timeout=2.0)
+                self.mesh_bootstrap.adopt_state(wire)
         except (RpcUnreachable, RpcError) as e:
             log.warning("standby sync from %s failed: %s", addr, e)
 

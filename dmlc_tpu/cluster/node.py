@@ -337,7 +337,10 @@ class ClusterNode:
         from dmlc_tpu.parallel import multihost
 
         return multihost.join_global_mesh(
-            self.rpc, self.tracker.current, self.self_member_addr, timeout_s=timeout_s
+            self.rpc,
+            lambda: self.tracker.current,  # re-resolved per poll: failover-safe
+            self.self_member_addr,
+            timeout_s=timeout_s,
         )
 
     def predict(self) -> dict:

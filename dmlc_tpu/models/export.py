@@ -1,0 +1,131 @@
+"""StableHLO export toolchain (SURVEY §7 L0): models as portable executables.
+
+The reference distributes models as tch ``.ot`` weight files interpreted by a
+libtorch runtime baked into every binary (src/services.rs:513-524). The
+TPU-native equivalent distributes two artifacts through SDFS:
+
+- **weights** (models/weights.py) — the variables tree, hot-swappable;
+- **executables** (this module) — the whole serving program (device-side
+  normalize -> forward -> softmax -> top-1) exported with ``jax.export`` to a
+  versioned StableHLO artifact. The artifact is weight-agnostic (variables
+  are an argument), hardware-portable within jax's compatibility guarantees,
+  and re-executable WITHOUT the model's Python source: ``deserialize`` +
+  ``call`` is the whole loader.
+
+This is the credible core of "native serving": the artifact is compiler IR
+(VHLO/StableHLO bytes, inspectable via ``stablehlo_text``), not pickled
+Python. Executing it outside a Python process additionally needs a PJRT
+C-API host — see SURVEY.md §7 for why that loader is deferred and what the
+boundary is.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+from dmlc_tpu.models import weights as weights_lib
+from dmlc_tpu.models.registry import get_model
+from dmlc_tpu.ops import preprocess as pp
+
+MAGIC = b"DMLCHLO1"
+
+
+def sdfs_executable_name(model_name: str) -> str:
+    """Canonical SDFS name for a model's serving executable."""
+    return f"executables/{model_name}"
+
+
+def build_serving_forward(model_name: str, dtype=jnp.bfloat16):
+    """The serving program: uint8 NHWC -> (top1_index, top1_prob) for
+    classifiers, or the embedding matrix for encoders. Mirrors
+    InferenceEngine's XLA path (parallel/inference.py) — the export parity
+    test pins the two together."""
+    spec = get_model(model_name)
+    model = spec.module(dtype=dtype)
+    mean_np, std_np = pp.stats_for_model(model_name)
+    mean, std = jnp.asarray(mean_np), jnp.asarray(std_np)
+
+    def forward(variables, u8):
+        x = u8.astype(jnp.float32) / 255.0
+        x = (x - mean) / std
+        out = model.apply(variables, x, train=False)
+        if spec.classifier:
+            probs = jax.nn.softmax(out, axis=-1)
+            return jnp.argmax(probs, -1).astype(jnp.int32), jnp.max(probs, -1)
+        return out
+
+    return forward
+
+
+def export_serving(model_name: str, batch_size: int = 256, dtype=jnp.bfloat16) -> bytes:
+    """Trace + export the serving program on abstract shapes -> one blob
+    (magic + model name + serialized StableHLO artifact)."""
+    spec = get_model(model_name)
+    forward = build_serving_forward(model_name, dtype=dtype)
+    template = weights_lib.variables_template(model_name)
+    u8 = jax.ShapeDtypeStruct((batch_size, spec.input_size, spec.input_size, 3), jnp.uint8)
+    exported = jax_export.export(jax.jit(forward))(template, u8)
+    name_b = model_name.encode()
+    return MAGIC + len(name_b).to_bytes(2, "big") + name_b + bytes(exported.serialize())
+
+
+def load_serving(data: bytes, expect_model: str | None = None):
+    """-> (model_name, exported): the deserialized artifact. ``exported.call``
+    executes it — no model source code involved."""
+    if data[: len(MAGIC)] != MAGIC:
+        raise ValueError("not a dmlc executable blob (bad magic)")
+    off = len(MAGIC)
+    n = int.from_bytes(data[off : off + 2], "big")
+    model_name = data[off + 2 : off + 2 + n].decode()
+    if expect_model is not None and model_name != expect_model:
+        raise ValueError(f"executable is for {model_name!r}, expected {expect_model!r}")
+    exported = jax_export.deserialize(bytearray(data[off + 2 + n :]))
+    return model_name, exported
+
+
+def stablehlo_text(data: bytes) -> str:
+    """Human-readable StableHLO of a serialized executable blob."""
+    _, exported = load_serving(data)
+    return exported.mlir_module()
+
+
+def publish_executable(
+    sdfs_client, model_name: str, batch_size: int = 256, dtype=jnp.bfloat16
+) -> int:
+    """Export and put a new executable version into SDFS; returns version."""
+    blob = export_serving(model_name, batch_size=batch_size, dtype=dtype)
+    return sdfs_client.put_bytes(blob, sdfs_executable_name(model_name))["version"]
+
+
+def fetch_executable(sdfs_client, model_name: str, version: int | None = None):
+    """Pull + deserialize a model's executable from SDFS ->
+    (version, exported)."""
+    v, blob = sdfs_client.get_bytes(sdfs_executable_name(model_name), version=version)
+    _, exported = load_serving(blob, expect_model=model_name)
+    return v, exported
+
+
+class ExportedServer:
+    """Serve batches straight from a deserialized artifact: the minimal
+    'loader' — everything the member needs to answer predict shards is the
+    blob + the weights, no model source."""
+
+    def __init__(self, exported, variables, batch_size: int, classifier: bool = True):
+        self.exported = exported
+        self.variables = variables
+        self.batch_size = int(batch_size)
+        self.classifier = classifier
+
+    def __call__(self, batch_u8: np.ndarray):
+        n = batch_u8.shape[0]
+        if n < self.batch_size:
+            pad = np.zeros((self.batch_size - n, *batch_u8.shape[1:]), batch_u8.dtype)
+            batch_u8 = np.concatenate([batch_u8, pad])
+        out = self.exported.call(self.variables, batch_u8)
+        if self.classifier:
+            idx, top = (np.asarray(o)[:n] for o in out)
+            return idx, top
+        return np.asarray(out)[:n]

@@ -56,7 +56,21 @@ class MeshBootstrap:
         self._lock = threading.Lock()
 
     def methods(self) -> dict:
-        return {"mesh.register": self._register, "mesh.info": self._info}
+        return {
+            "mesh.register": self._register,
+            "mesh.info": self._info,
+            "mesh.state": self._state_wire,
+        }
+
+    def _state_wire(self, p: dict) -> dict:
+        """Rank-map replication payload for standby leaders: without it a
+        failover would re-rank already-initialized processes."""
+        with self._lock:
+            return {"ranks": dict(self.ranks)}
+
+    def adopt_state(self, wire: dict) -> None:
+        with self._lock:
+            self.ranks = {str(a): int(r) for a, r in wire["ranks"].items()}
 
     def _register(self, p: dict) -> dict:
         addr = p["addr"]
@@ -92,29 +106,42 @@ class MeshBootstrap:
         }
 
 
+# RpcError fragments that polling can never fix — fail fast instead of
+# burning the whole join window.
+_PERMANENT_ERRORS = ("unknown method", "mesh is full")
+
+
 def register_until_ready(
     rpc: Rpc,
-    leader_addr: str,
+    leader_addr,
     self_addr: str,
     timeout_s: float = 120.0,
     poll_s: float = 0.5,
 ) -> dict:
     """Register with the leader and poll until every expected process has —
     returns the final {process_id, num_processes, coordinator, ...} info.
-    Transient leader failures (connection drops, a candidate still deferring
-    mid-election) keep polling until the deadline instead of aborting the
-    whole fleet's join."""
+
+    ``leader_addr`` may be a callable re-resolved every poll (the node's
+    LeaderTracker) so a leader failover mid-join redirects to the promoted
+    standby instead of stranding the fleet. Transient failures (connection
+    drops, a candidate still deferring mid-election) keep polling until the
+    deadline; permanent refusals (mesh not configured, mesh full) raise
+    immediately."""
+    addr_fn = leader_addr if callable(leader_addr) else (lambda: leader_addr)
     deadline = time.monotonic() + timeout_s
     info = None
     last_err: Exception | None = None
     while time.monotonic() < deadline:
+        addr = addr_fn()
         try:
-            info = rpc.call(leader_addr, "mesh.register", {"addr": self_addr})
+            info = rpc.call(addr, "mesh.register", {"addr": self_addr})
             if info["ready"]:
                 return info
         except RpcError as e:
+            if any(frag in str(e) for frag in _PERMANENT_ERRORS):
+                raise
             last_err = e
-            log.warning("mesh.register at %s failed (will retry): %s", leader_addr, e)
+            log.warning("mesh.register at %s failed (will retry): %s", addr, e)
         time.sleep(poll_s)
     raise TimeoutError(
         f"global mesh never became ready: {info and info['registered']}"
@@ -142,9 +169,10 @@ def initialize_global_runtime(info: dict) -> None:
 
 
 def join_global_mesh(
-    rpc: Rpc, leader_addr: str, self_addr: str, timeout_s: float = 120.0
+    rpc: Rpc, leader_addr, self_addr: str, timeout_s: float = 120.0
 ) -> dict:
-    """The member-side one-call path: register, wait for the fleet, join."""
+    """The member-side one-call path: register, wait for the fleet, join.
+    ``leader_addr`` may be a callable (see register_until_ready)."""
     info = register_until_ready(rpc, leader_addr, self_addr, timeout_s=timeout_s)
     initialize_global_runtime(info)
     return info

@@ -181,3 +181,59 @@ def np_finite(x) -> bool:
     import numpy as np
 
     return bool(np.isfinite(x))
+
+
+def test_register_fails_fast_on_permanent_errors():
+    """'unknown method' (mesh not configured) and 'mesh is full' must not
+    burn the whole join window."""
+    import time
+
+    net = SimRpcNetwork()
+    net.serve("L", {})  # no mesh.register method at all
+    t0 = time.monotonic()
+    with pytest.raises(RpcError, match="unknown method"):
+        register_until_ready(net.client("x"), "L", "hostA:1", timeout_s=30.0, poll_s=0.01)
+    assert time.monotonic() - t0 < 5.0
+
+    boot = MeshBootstrap(coordinator_port=1, num_processes=1)
+    net.serve("L2", boot.methods())
+    net.client("x").call("L2", "mesh.register", {"addr": "hostA:1"})
+    with pytest.raises(RpcError, match="full"):
+        register_until_ready(net.client("x"), "L2", "hostB:1", timeout_s=30.0, poll_s=0.01)
+
+
+def test_register_redirects_to_promoted_standby():
+    """leader_addr as a callable: a failover mid-join redirects the polling
+    to the promoted standby, which adopted the primary's rank map."""
+    import threading
+    import time
+
+    net = SimRpcNetwork()
+    primary = MeshBootstrap(coordinator_port=8853, num_processes=2)
+    standby = MeshBootstrap(coordinator_port=8853, num_processes=2, is_leading=False)
+    net.serve("L0", primary.methods())
+    net.serve("L1", standby.methods())
+
+    # First process registers at the primary, then the primary dies.
+    first = net.client("a").call("L0", "mesh.register", {"addr": "hostA:1"})
+    assert first["process_id"] == 0
+    standby.adopt_state(net.client("L1").call("L0", "mesh.state", {}))  # sync loop
+    net.crash("L0")
+    current = ["L0"]
+
+    def failover():
+        time.sleep(0.05)
+        standby.is_leading = True  # promotion
+        current[0] = "L1"         # tracker advances
+        time.sleep(0.05)
+        net.client("a").call("L1", "mesh.register", {"addr": "hostA:1"})
+
+    t = threading.Thread(target=failover)
+    t.start()
+    info = register_until_ready(
+        net.client("b"), lambda: current[0], "hostB:1", timeout_s=5.0, poll_s=0.01
+    )
+    t.join()
+    assert info["ready"] and info["process_id"] == 1
+    # hostA kept rank 0 across the failover, so the coordinator is stable.
+    assert info["coordinator"] == "hostA:8853"
