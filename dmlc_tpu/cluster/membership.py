@@ -27,6 +27,7 @@ over DCN, and chips never appear here — devices are the mesh's concern
 from __future__ import annotations
 
 import logging
+import random
 import threading
 from dataclasses import dataclass, field
 from enum import Enum
@@ -99,6 +100,9 @@ class MembershipNode:
         # merges only. This makes detection latency independent of clock skew.
         self._last_heard: dict[NodeId, float] = {}
         self._left = False
+        # Deterministic per-node RNG for gossip sampling: reproducible sim
+        # runs, distinct sequences across nodes.
+        self._rng = random.Random(hash(self.self_id))
         # handle() runs on the transport's receiver thread while step() runs
         # on the node's stepper thread; all state access goes through this
         # lock (a no-op cost in the single-threaded simulator).
@@ -191,7 +195,28 @@ class MembershipNode:
         )
 
     def _wire_list(self) -> list:
-        return [[i[0], i[1], *m.to_wire()] for i, m in self.members.items()]
+        """Gossip payload: at most gossip_max_entries entries per datagram.
+
+        Self is always included; non-ACTIVE verdicts (FAILED/LEFT) are
+        prioritized so failure news rides every ping; the remaining slots are
+        a random sample that rotates per ping — anti-entropy converges over
+        rounds while the datagram stays bounded at any fleet size (the
+        reference gossiped the full list, O(N) per heartbeat,
+        membership.rs:242-257)."""
+        cap = max(1, self.config.gossip_max_entries)
+        if len(self.members) <= cap:
+            entries = list(self.members.items())
+        else:
+            rest = [
+                (i, m) for i, m in self.members.items() if i != self.self_id
+            ]
+            verdicts = [e for e in rest if e[1].status != Status.ACTIVE]
+            actives = [e for e in rest if e[1].status == Status.ACTIVE]
+            self._rng.shuffle(verdicts)
+            self._rng.shuffle(actives)
+            take = (verdicts + actives)[: cap - 1]
+            entries = [(self.self_id, self.members[self.self_id])] + take
+        return [[i[0], i[1], *m.to_wire()] for i, m in entries]
 
     # ---- message handling ---------------------------------------------
 

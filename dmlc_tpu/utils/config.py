@@ -33,6 +33,11 @@ class ClusterConfig:
     heartbeat_interval_s: float = 1.0   # src/membership.rs:230
     failure_timeout_s: float = 3.0      # src/membership.rs:273
     ring_k: int = 2                     # k=2 symmetric ring neighbors, src/membership.rs:242
+    # Max membership entries per gossip datagram. The reference ships the
+    # FULL list every ping (membership.rs:242-257), O(N) per heartbeat; a
+    # bounded random sample (self always included) keeps datagrams under the
+    # UDP limit at any fleet size while anti-entropy still converges.
+    gossip_max_entries: int = 64
 
     # --- SDFS ---
     storage_dir: str = "storage"        # src/services.rs:34

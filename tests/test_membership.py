@@ -15,10 +15,10 @@ from dmlc_tpu.utils.config import ClusterConfig
 class SimCluster:
     """N membership nodes on an in-memory fabric with a shared fake clock."""
 
-    def __init__(self, n: int, ring_k: int = 2):
+    def __init__(self, n: int, ring_k: int = 2, **config_overrides):
         self.net = SimNetwork()
         self.clock = SimClock()
-        self.config = ClusterConfig(ring_k=ring_k)
+        self.config = ClusterConfig(ring_k=ring_k, **config_overrides)
         self.nodes: dict[str, MembershipNode] = {}
         for i in range(n):
             addr = f"node{i}:8850"
@@ -201,3 +201,42 @@ def test_udp_transport_roundtrip():
     finally:
         a.close()
         b.close()
+
+
+def test_100_node_convergence_with_bounded_datagrams(monkeypatch):
+    """Anti-entropy with a gossip cap: a 100-node cluster converges to full
+    visibility, a failure verdict still propagates everywhere, and no
+    datagram ever exceeds the bound (the reference shipped the full O(N)
+    list per ping, membership.rs:242-257)."""
+    from dmlc_tpu.cluster.transport import SimNetwork as _SimNetwork
+
+    sizes = []
+    orig_enqueue = _SimNetwork._enqueue
+
+    def measuring_enqueue(self, src, dst, data):
+        sizes.append(len(data))
+        return orig_enqueue(self, src, dst, data)
+
+    monkeypatch.setattr(_SimNetwork, "_enqueue", measuring_enqueue)
+
+    c = SimCluster(100, ring_k=3, gossip_max_entries=16)
+    c.rounds(60)
+
+    # Full visibility at every node despite 16-entry datagrams.
+    for addr in c.nodes:
+        seen = c.statuses_seen_by(addr)
+        assert len(seen) == 100
+        assert all(s == "active" for s in seen.values()), addr
+
+    # A crash is detected by ring neighbors and the verdict reaches everyone.
+    victim = "node42:8850"
+    c.net.crash(victim)
+    c.rounds(40)
+    for addr in c.nodes:
+        if addr == victim:
+            continue
+        assert c.statuses_seen_by(addr)[victim] == "failed", addr
+
+    # Bounded payloads: 16 entries of ("nodeNN:8850", float, status, float)
+    # msgpack-encode well under 2 KB; assert with headroom.
+    assert sizes and max(sizes) < 2048, max(sizes)
