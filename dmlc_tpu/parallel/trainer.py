@@ -1,0 +1,84 @@
+"""Checkpointed training driver: the dp x tp train step in a restartable loop.
+
+The reference's `train` verb never trains (it broadcasts pretrained files,
+src/services.rs:139-144); its only resume machinery is the replicated job
+cursor. This driver completes the training story the TPU-native way: the
+SPMD step from parallel/train.py runs under one jit, and every
+``checkpoint_every`` steps the FULL TrainState (params, optimizer moments,
+batch stats, step counter) is saved as a new replicated SDFS version via
+utils/checkpoint.py — so a crashed driver, or a new driver started after
+leader failover on a different node, restores from the replicated store and
+continues exactly where training stopped (tests/test_train_driver.py kills
+the SDFS leader mid-run and restores via the promoted standby).
+
+``data_fn(step) -> (images, labels)`` abstracts the input pipeline: tests
+use synthetic batches; a real run feeds decoded corpus batches (the
+ops/preprocess stream) the same way.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+import jax
+
+from dmlc_tpu.parallel import train as train_lib
+
+log = logging.getLogger(__name__)
+
+
+class TrainingDriver:
+    """Drive ``steps`` train steps with periodic replicated checkpoints.
+
+    ``checkpointer`` is anything with save(state, step) / restore(template)
+    — an SdfsCheckpointer for replicated storage, or None to disable."""
+
+    def __init__(
+        self,
+        mesh,
+        state: train_lib.TrainState,
+        data_fn: Callable[[int], tuple],
+        checkpointer=None,
+        checkpoint_every: int = 100,
+    ):
+        self.mesh = mesh
+        self.data_fn = data_fn
+        self.checkpointer = checkpointer
+        self.checkpoint_every = int(checkpoint_every)
+        self.history: list[dict] = []
+        # Restore BEFORE sharding: the template must be host-side with the
+        # same tree structure the checkpoint was saved from.
+        self.start_step = 0
+        if checkpointer is not None:
+            try:
+                state, self.start_step = checkpointer.restore(state)
+                log.info("restored checkpoint at step %d", self.start_step)
+            except Exception as e:  # no checkpoint yet — fresh run
+                log.info("no checkpoint to restore (%s); starting fresh", e)
+        self.state, self.step_fn = train_lib.make_train_step(mesh, state)
+
+    def run(self, steps: int) -> dict:
+        """Train until the global step counter reaches ``start + steps``.
+        Returns the last metrics. Checkpoints every checkpoint_every steps
+        and once more at the end."""
+        step = self.start_step
+        last = {}
+        for _ in range(steps):
+            images, labels = self.data_fn(step)
+            self.state, metrics = self.step_fn(self.state, images, labels)
+            step += 1
+            last = {k: float(v) for k, v in metrics.items()}
+            self.history.append({"step": step, **last})
+            if self.checkpointer is not None and step % self.checkpoint_every == 0:
+                self._save(step)
+        if self.checkpointer is not None and step % self.checkpoint_every != 0:
+            self._save(step)
+        self.start_step = step
+        return last
+
+    def _save(self, step: int) -> None:
+        host_state = jax.tree_util.tree_map(
+            lambda x: jax.device_get(x) if hasattr(x, "shape") else x, self.state
+        )
+        self.checkpointer.save(host_state, step)

@@ -1,0 +1,118 @@
+"""Checkpointed training in anger: dp x tp steps with replicated SDFS
+checkpoints, leader killed mid-training, training resumed from the
+checkpoint served by the promoted standby (VERDICT r1 item 9)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dmlc_tpu.cluster.failover import StandbyLeader
+from dmlc_tpu.cluster.rpc import SimRpcNetwork
+from dmlc_tpu.cluster.sdfs import MemberStore, SdfsClient, SdfsLeader, SdfsMember
+from dmlc_tpu.models.vit import ViT
+from dmlc_tpu.parallel import mesh as mesh_lib
+from dmlc_tpu.parallel import train as train_lib
+from dmlc_tpu.parallel.trainer import TrainingDriver
+from dmlc_tpu.scheduler.jobs import JobScheduler
+from dmlc_tpu.utils.checkpoint import SdfsCheckpointer
+
+
+def fresh_state():
+    model = ViT(
+        num_classes=8, patch_size=8, hidden_size=32, num_layers=1,
+        num_heads=2, mlp_dim=64, dtype=jnp.float32,
+    )
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3), jnp.float32), train=False
+    )
+    return train_lib.create_train_state(model, variables, train_lib.default_optimizer(1e-3))
+
+
+def data_fn(step: int):
+    rng = np.random.RandomState(step)
+    images = rng.randn(8, 16, 16, 3).astype(np.float32)
+    labels = rng.randint(0, 8, size=(8,))
+    return images, labels
+
+
+def host_tree(tree):
+    return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+def test_driver_checkpoints_and_survives_leader_kill(tmp_path):
+    net = SimRpcNetwork()
+    live = ["m0", "m1", "m2"]
+    stores = {}
+    for m in live:
+        stores[m] = MemberStore(tmp_path / m)
+        net.serve(m, SdfsMember(stores[m], net.client(m)).methods())
+
+    # Primary (L0, actively leading) + standby (L1) with directory sync.
+    primary_sdfs = SdfsLeader(net.client("L0"), lambda: list(live), replication_factor=2)
+    primary_jobs = JobScheduler(net.client("L0"), lambda: list(live), jobs={})
+    primary_jobs.is_leading = True
+    net.serve("L0", {**primary_sdfs.methods(), **primary_jobs.methods()})
+    standby_sdfs = SdfsLeader(
+        net.client("L1"), lambda: list(live), replication_factor=2, is_leading=False
+    )
+    standby_jobs = JobScheduler(net.client("L1"), lambda: list(live), jobs={})
+    net.serve("L1", {**standby_sdfs.methods(), **standby_jobs.methods()})
+    monitor = StandbyLeader(
+        net.client("L1"), "L1", ["L0", "L1"], standby_jobs, sdfs_leader=standby_sdfs
+    )
+
+    mesh = mesh_lib.make_mesh({"dp": 4, "tp": 2})
+
+    # --- phase 1: train with periodic replicated checkpoints -------------
+    client0 = SdfsClient(net.client("m0"), "L0", stores["m0"], "m0")
+    driver1 = TrainingDriver(
+        mesh,
+        fresh_state(),
+        data_fn,
+        checkpointer=SdfsCheckpointer(client0),
+        checkpoint_every=2,
+    )
+    assert driver1.start_step == 0  # nothing to restore yet
+    driver1.run(3)  # checkpoints at step 2 and (final) step 3
+    assert [h["step"] for h in driver1.history] == [1, 2, 3]
+    params_after_3 = host_tree(driver1.state.params)
+
+    monitor.step()  # standby mirrors the directory (checkpoint versions)
+    assert standby_sdfs.state.latest_version("checkpoints/train_state") == 2
+
+    # --- leader dies mid-training ---------------------------------------
+    net.crash("L0")
+    monitor.step()
+    assert monitor.is_leader  # promoted; SDFS writes now accepted at L1
+
+    # --- phase 2: a NEW driver on the new leader restores + continues ----
+    client1 = SdfsClient(net.client("m1"), "L1", stores["m1"], "m1")
+    driver2 = TrainingDriver(
+        mesh,
+        fresh_state(),
+        data_fn,
+        checkpointer=SdfsCheckpointer(client1),
+        checkpoint_every=2,
+    )
+    assert driver2.start_step == 3  # restored from the replicated checkpoint
+    restored_params = host_tree(driver2.state.params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+        restored_params,
+        params_after_3,
+    )
+
+    last = driver2.run(2)  # steps 4, 5 — checkpointed through the NEW leader
+    assert [h["step"] for h in driver2.history] == [4, 5]
+    assert int(driver2.state.step) == 5
+    assert np.isfinite(last["loss"])
+    # The post-failover checkpoint is a fresh version in the same file.
+    assert standby_sdfs.state.latest_version("checkpoints/train_state") >= 3
+
+
+def test_driver_fresh_run_without_checkpointer():
+    mesh = mesh_lib.make_mesh({"dp": 8})
+    driver = TrainingDriver(mesh, fresh_state(), data_fn, checkpointer=None)
+    first = driver.run(2)
+    assert int(driver.state.step) == 2
+    assert np.isfinite(first["loss"]) and 0.0 <= first["accuracy"] <= 1.0
