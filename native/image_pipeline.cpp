@@ -52,6 +52,11 @@ bool decode_jpeg(const char* path, int target, std::vector<uint8_t>& rgb,
   if (!f) return false;
   jpeg_decompress_struct cinfo;
   ErrorMgr jerr;
+  // Every C++ object with a destructor is constructed BEFORE setjmp:
+  // longjmp from the libjpeg error handler unwinds no C++ frames, so an
+  // object constructed after setjmp would leak its heap on every corrupt
+  // JPEG (and is formally UB to jump over).
+  std::vector<uint8_t> row;
   cinfo.err = jpeg_std_error(&jerr.pub);
   jerr.pub.error_exit = error_exit;
   if (setjmp(jerr.setjmp_buffer)) {
@@ -79,7 +84,7 @@ bool decode_jpeg(const char* path, int target, std::vector<uint8_t>& rgb,
   h = cinfo.output_height;
   int channels = cinfo.output_components;  // 3 for JCS_RGB
   rgb.resize((size_t)w * h * 3);
-  std::vector<uint8_t> row((size_t)w * channels);
+  row.resize((size_t)w * channels);
   while (cinfo.output_scanline < cinfo.output_height) {
     uint8_t* rowptr = row.data();
     jpeg_read_scanlines(&cinfo, &rowptr, 1);

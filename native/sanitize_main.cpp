@@ -1,0 +1,41 @@
+// Sanitizer harness for the native image pipeline (SURVEY §5: the rebuild
+// must recover, via TSan/ASan, the memory/race safety the reference got for
+// free from Rust). Drives dmlc_decode_resize_batch across threads, repeating
+// the argv path list (which deliberately includes corrupt files so the
+// libjpeg longjmp error path runs under the sanitizer too). Exit code 0 =
+// no sanitizer report; decode failures are expected and NOT errors.
+//
+// Built by `make sanitize` as two binaries: sanitize_asan
+// (-fsanitize=address,undefined + LeakSanitizer) and sanitize_tsan
+// (-fsanitize=thread). Driven by tests/test_native_sanitize.py.
+
+#include <cstdint>
+#include <cstdio>
+#include <vector>
+
+extern "C" int dmlc_decode_resize_batch(const char** paths, int n, int size,
+                                        uint8_t* out, int* status,
+                                        int n_threads);
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s jpeg [jpeg...]\n", argv[0]);
+    return 2;
+  }
+  const int repeats = 8;  // enough work items to keep 4 threads contending
+  const int size = 64;
+  std::vector<const char*> paths;
+  for (int r = 0; r < repeats; ++r)
+    for (int i = 1; i < argc; ++i) paths.push_back(argv[i]);
+  int n = (int)paths.size();
+  std::vector<uint8_t> out((size_t)n * size * size * 3);
+  std::vector<int> status(n);
+  int total_failures = 0;
+  for (int round = 0; round < 3; ++round) {
+    total_failures += dmlc_decode_resize_batch(paths.data(), n, size,
+                                               out.data(), status.data(), 4);
+  }
+  std::printf("decoded %d items x3 rounds, %d failures (corrupt inputs expected)\n",
+              n, total_failures);
+  return 0;
+}

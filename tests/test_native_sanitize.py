@@ -1,0 +1,77 @@
+"""TSan/ASan runs of the native image pipeline (SURVEY §5).
+
+Builds the sanitizer harness binaries via `make sanitize` and drives the
+thread-pooled decode over real JPEGs PLUS corrupt inputs (exercising the
+libjpeg longjmp error path, which historically leaked). A nonzero exit is a
+sanitizer report — ASan aborts on memory errors and LeakSanitizer reports
+leaks at exit; TSan aborts on data races."""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+NATIVE_DIR = Path(__file__).parent.parent / "native"
+
+
+def _toolchain_missing():
+    return shutil.which("g++") is None or shutil.which("make") is None
+
+
+@pytest.fixture(scope="module")
+def harness_binaries():
+    if _toolchain_missing():
+        pytest.skip("g++/make not available")
+    try:
+        subprocess.run(
+            ["make", "-s", "sanitize"],
+            cwd=NATIVE_DIR,
+            check=True,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+    except subprocess.CalledProcessError as e:
+        pytest.skip(f"sanitizer toolchain unavailable: {e.stderr[-500:]}")
+    return NATIVE_DIR / "sanitize_asan", NATIVE_DIR / "sanitize_tsan"
+
+
+@pytest.fixture(scope="module")
+def jpeg_inputs(tmp_path_factory):
+    """A few valid JPEGs of varied sizes + corrupt files (truncated JPEG,
+    pure garbage, empty) so the longjmp error path runs under sanitizers."""
+    from PIL import Image
+
+    d = tmp_path_factory.mktemp("san_jpegs")
+    rng = np.random.default_rng(3)
+    paths = []
+    for i, side in enumerate((640, 200, 64)):
+        p = d / f"ok{i}.jpg"
+        base = rng.integers(0, 256, (side // 8, side // 8, 3), np.uint8)
+        Image.fromarray(base).resize((side, side)).save(p, quality=85)
+        paths.append(p)
+    truncated = d / "truncated.jpg"
+    truncated.write_bytes(paths[0].read_bytes()[: 1 << 10])
+    garbage = d / "garbage.jpg"
+    garbage.write_bytes(bytes(rng.integers(0, 256, 4096, np.uint8)))
+    empty = d / "empty.jpg"
+    empty.write_bytes(b"")
+    return [str(p) for p in paths + [truncated, garbage, empty]]
+
+
+@pytest.mark.parametrize("which", ["asan", "tsan"])
+def test_sanitized_decode(harness_binaries, jpeg_inputs, which):
+    asan, tsan = harness_binaries
+    binary = asan if which == "asan" else tsan
+    proc = subprocess.run(
+        [str(binary), *jpeg_inputs],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, (
+        f"{which} reported a problem:\n{proc.stdout[-1000:]}\n{proc.stderr[-3000:]}"
+    )
+    assert "failures" in proc.stdout
