@@ -103,6 +103,19 @@ class ClusterNode:
             self.rpc, self.tracker.current, self.store, self.self_member_addr
         )
 
+        # BASELINE "SDFS shard" config: members with no local corpus resolve
+        # class images through the replicated store, cached on local disk.
+        # Wired after SdfsClient exists; only backends this node built get it.
+        if self.config.data_from_sdfs:
+            from dmlc_tpu.scheduler.dataset import SdfsImageSource
+
+            source = SdfsImageSource(
+                self.sdfs, Path(self.config.storage_dir).parent / "data_cache"
+            )
+            for backend in self.worker.backends.values():
+                if hasattr(backend, "image_source") and backend.image_source is None:
+                    backend.image_source = source
+
     # ---- leader side ---------------------------------------------------
 
     def _load_workload(self) -> list[tuple[str, int]]:

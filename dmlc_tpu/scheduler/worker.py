@@ -57,10 +57,19 @@ class EngineBackend:
     reference serialized with a model mutex too (services.rs:493).
     """
 
-    def __init__(self, model_name: str, data_dir: str | Path, batch_size: int = 256):
+    def __init__(
+        self,
+        model_name: str,
+        data_dir: str | Path,
+        batch_size: int = 256,
+        image_source=None,
+    ):
         self.model_name = model_name
         self.data_dir = Path(data_dir)
         self.batch_size = batch_size
+        # Optional synsets -> local paths resolver (e.g. an SdfsImageSource
+        # for the BASELINE "SDFS shard" config); None = local fixture dirs.
+        self.image_source = image_source
         self._engine = None
         self._lock = threading.Lock()
 
@@ -87,7 +96,10 @@ class EngineBackend:
 
         with self._lock:
             engine = self._ensure_engine()
-            paths = [pp.class_image_path(self.data_dir, s) for s in synsets]
+            if self.image_source is not None:
+                paths = list(self.image_source(synsets))
+            else:
+                paths = [pp.class_image_path(self.data_dir, s) for s in synsets]
             if len(paths) <= self.batch_size:
                 result = engine.run_paths(paths)
             else:

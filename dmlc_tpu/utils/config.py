@@ -61,6 +61,10 @@ class ClusterConfig:
     model_dtype: str = "bfloat16"
     data_dir: str = "test_files/imagenet_1k/train"
     synset_path: str = "synset_words.txt"
+    # Resolve class images through SDFS (published via
+    # scheduler/dataset.publish_corpus) instead of a pre-installed local
+    # corpus — the BASELINE "4-node SDFS shard" configuration.
+    data_from_sdfs: bool = False
     # The reference's two static jobs (src/services.rs:168-169); any registry
     # model name works here.
     job_models: list[str] = field(default_factory=lambda: ["resnet18", "alexnet"])
