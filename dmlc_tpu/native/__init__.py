@@ -67,10 +67,23 @@ def build() -> None:
     _lib, _load_failed = None, False  # rebind on next use
 
 
+def _stale() -> bool:
+    """Is the .so missing or older than any native source? Checked in
+    Python so a prebuilt library on a toolchain-less host never spawns
+    make (and fresh libraries are never needlessly re-linked under a
+    concurrently-starting fleet)."""
+    if not _LIB_PATH.exists():
+        return True
+    so_mtime = _LIB_PATH.stat().st_mtime
+    sources = list(_SRC_DIR.glob("*.cpp")) + [_SRC_DIR / "Makefile"]
+    return any(s.exists() and s.stat().st_mtime > so_mtime for s in sources)
+
+
 def ensure_built() -> bool:
-    """Build if missing (best effort) and report availability. Call at node
-    startup / bench setup — never from the per-shard path."""
-    if not _LIB_PATH.exists() and not _load_failed:
+    """Build if missing or source-stale (best effort) and report
+    availability. Call at node startup / bench setup — never from the
+    per-shard path."""
+    if not _load_failed and _stale():
         try:
             build()
         except Exception as e:

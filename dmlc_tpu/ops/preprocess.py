@@ -65,7 +65,9 @@ def decode_resize(path: str | Path, size: int = 224) -> np.ndarray:
     from PIL import Image
 
     with Image.open(path) as im:
-        im = im.convert("RGB").resize((size, size), Image.BILINEAR)
+        im = im.convert("RGB")
+        if im.size != (size, size):  # already-staged sizes skip the resample
+            im = im.resize((size, size), Image.BILINEAR)
         return np.asarray(im, dtype=np.uint8)
 
 

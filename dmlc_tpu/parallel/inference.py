@@ -55,10 +55,17 @@ class InferenceEngine:
         batch_size: int = 256,
         seed: int = 0,
         use_pallas: bool | None = None,
+        device_resize_from: int | None = None,
     ):
         self.spec = get_model(model_name)
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
         self.batch_size = int(batch_size)
+        # Optional device-side resize (ops/device_resize.py): the host ships
+        # raw [B, R, R, 3] uint8 (R = device_resize_from, e.g. the corpus's
+        # native/DCT-scaled size) and the chip resizes to the model's input
+        # via MXU matmuls fused into the first conv — cutting the ~35% of
+        # host CPU that resize costs (measured, ops/device_resize.py).
+        self.device_resize_from = device_resize_from
         self.model = self.spec.module(dtype=dtype)
         if variables is None:
             _, variables = self.spec.init_params(jax.random.PRNGKey(seed), dtype=dtype)
@@ -78,8 +85,16 @@ class InferenceEngine:
         data_shd = mesh_lib.batch_sharding(self.mesh)
         classifier = self.spec.classifier
 
+        resize_from = self.device_resize_from
+        input_size = self.spec.input_size
+
         def forward(variables, u8):
-            if self.use_pallas:
+            if resize_from is not None and resize_from != input_size:
+                from dmlc_tpu.ops import device_resize
+
+                x = device_resize.resize_batch(u8, input_size) / 255.0
+                x = (x - mean) / std
+            elif self.use_pallas:
                 from dmlc_tpu.ops import pallas_kernels as pk
 
                 x = pk.normalize_u8(u8, mean_np, std_np, jnp.float32)
@@ -103,7 +118,10 @@ class InferenceEngine:
 
     @property
     def input_size(self) -> int:
-        return self.spec.input_size
+        """Host-side staging size: what decoded batches must be shaped as.
+        With device resize active this is the RAW size; the model's input
+        size is reached on the chip."""
+        return self.device_resize_from or self.spec.input_size
 
     def load_variables(self, variables) -> None:
         """Hot-swap the model weights (the member side of the `train` verb,

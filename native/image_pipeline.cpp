@@ -146,6 +146,10 @@ Taps make_taps(int in_size, int out_size) {
 
 // Separable resample: [h, w, 3] u8 -> [out, out, 3] u8.
 void resize_triangle(const uint8_t* src, int w, int h, int out, uint8_t* dst) {
+  if (w == out && h == out) {  // already staged (device-resize mode)
+    std::memcpy(dst, src, (size_t)out * out * 3);
+    return;
+  }
   Taps tx = make_taps(w, out);
   Taps ty = make_taps(h, out);
   // Horizontal pass: [h, out, 3] float.

@@ -1,0 +1,76 @@
+"""On-device matmul resize: weight semantics, numerics vs PIL, and the
+engine's raw-staging mode (ops/device_resize.py)."""
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.ops import device_resize
+from tiny_model import N_CLASSES  # registers tinynet
+
+
+def smooth_images(n, size, seed=0):
+    """Low-frequency uint8 fields — photograph-like, so resample parity is
+    meaningful (pure noise makes every resampler disagree at the tolerance)."""
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        base = rng.integers(0, 256, (size // 8, size // 8, 3), np.uint8)
+        out.append(np.asarray(Image.fromarray(base).resize((size, size), Image.BILINEAR)))
+    return np.stack(out)
+
+
+def test_weights_are_row_stochastic():
+    for in_size, out_size in ((256, 224), (64, 224), (224, 224), (17, 5)):
+        w = device_resize.triangle_weights(in_size, out_size)
+        assert w.shape == (out_size, in_size)
+        np.testing.assert_allclose(w.sum(axis=1), 1.0, rtol=1e-6)
+        # A flat image stays flat through any row-stochastic resample.
+        flat = np.full((1, in_size, in_size, 3), 137, np.uint8)
+        res = np.asarray(device_resize.resize_batch(flat, out_size))
+        np.testing.assert_allclose(res, 137.0, atol=1e-3)
+
+
+def test_jax_matches_numpy_reference():
+    imgs = smooth_images(2, 64)
+    got = np.asarray(device_resize.resize_batch(imgs, 48))
+    want = device_resize.reference_resize(imgs, 48)
+    np.testing.assert_allclose(got, want, atol=1e-2)
+
+
+def test_close_to_pil_bilinear():
+    from PIL import Image
+
+    imgs = smooth_images(3, 256, seed=1)
+    got = np.asarray(device_resize.resize_batch(imgs, 224))
+    pil = np.stack(
+        [
+            np.asarray(Image.fromarray(im).resize((224, 224), Image.BILINEAR))
+            for im in imgs
+        ]
+    ).astype(np.float32)
+    # Same triangle-filter family; implementations differ in fixed-point
+    # detail. Mean within a fraction of a grey level, max within a few.
+    assert np.mean(np.abs(got - pil)) < 0.6
+    assert np.max(np.abs(got - pil)) < 6.0
+
+
+def test_engine_raw_staging_mode():
+    """device_resize_from: the engine stages RAW pixels and resizes on
+    device; predictions track the host-resized path."""
+    from dmlc_tpu.parallel.inference import InferenceEngine
+
+    raw = smooth_images(8, 48, seed=2)
+    host = InferenceEngine("tinynet", batch_size=8, seed=7)
+    dev = InferenceEngine("tinynet", batch_size=8, seed=7, device_resize_from=48)
+    assert dev.input_size == 48 and host.input_size == 32
+
+    host_in = np.asarray(device_resize.resize_batch(raw, 32)).round().clip(0, 255).astype(np.uint8)
+    want = host.run_batch(host_in)
+    got = dev.run_batch(raw)
+    # Same weights (same seed); inputs differ only by u8 rounding of the
+    # staged pixels, so top-1 agreement should be essentially total.
+    agree = np.mean(got.top1_index == want.top1_index)
+    assert agree >= 0.9, agree
+    np.testing.assert_allclose(got.top1_prob, want.top1_prob, atol=0.05)
