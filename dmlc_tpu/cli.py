@@ -181,6 +181,8 @@ class Cli:
                 )
                 out.append(f"  query latency: {format_latency(r['query_latency'])}")
                 out.append(f"  shard latency: {format_latency(r['shard_latency'])}")
+                for m, s in sorted(r.get("member_latency", {}).items()):
+                    out.append(f"    {m}: {format_latency(s)}")
             return "\n".join(out) or "no jobs"
         if cmd == "assign":
             rows = [

@@ -80,6 +80,7 @@ class ClusterNode:
             **self.sdfs_member.methods(),
             **self.worker.methods(),
             **self.model_loader.methods(),
+            "node.info": self._node_info,
         }
         self.member_server = TcpRpcServer(config.host, config.member_port, methods)
         self.self_member_addr = self.member_server.address
@@ -137,11 +138,13 @@ class ClusterNode:
             # the next directory sync).
             is_leading=False,
         )
+        self._weight_cache: dict[str, tuple[int, float]] = {}
         self.scheduler = JobScheduler(
             self.rpc,
             self.active_member_addrs,
             jobs={name: list(workload) for name in self.config.job_models},
             shard_size=self.config.dispatch_shard_size,
+            member_weight=self._member_weight,
         )
         methods = {**self.sdfs_leader.methods(), **self.scheduler.methods()}
         if self.config.mesh_processes > 1:
@@ -165,6 +168,39 @@ class ClusterNode:
             sdfs_leader=self.sdfs_leader,
             mesh_bootstrap=self.mesh_bootstrap,
         )
+
+    # ---- topology ------------------------------------------------------
+
+    def _node_info(self, p: dict) -> dict:
+        """Member RPC: this host's chip capacity, for the leader's
+        ICI-local weighted placement. Autodetect never *imports* jax — it
+        reads the count only when the engines already loaded it."""
+        chips = self.config.chips_per_host
+        if chips <= 0:
+            import sys
+
+            jax = sys.modules.get("jax")
+            try:
+                chips = jax.local_device_count() if jax is not None else 1
+            except Exception:
+                chips = 1
+        return {"chips": int(chips)}
+
+    def _member_weight(self, addr: str) -> int:
+        """TTL-cached node.info lookup used by the scheduler's assignment
+        pass; unreachable members keep their last known (or unit) weight."""
+        import time as _time
+
+        now = _time.monotonic()
+        cached = self._weight_cache.get(addr)
+        if cached is not None and now - cached[1] < 30.0:
+            return cached[0]
+        try:
+            w = int(self.rpc.call(addr, "node.info", {}, timeout=2.0)["chips"])
+        except Exception:
+            w = cached[0] if cached is not None else 1
+        self._weight_cache[addr] = (w, now)
+        return w
 
     # ---- liveness glue -------------------------------------------------
 

@@ -53,8 +53,16 @@ class Job:
     correct: int = 0
     running: bool = False
     assigned: list[str] = field(default_factory=list)
+    # Weighted dispatch pool: each assigned member repeated by its chip
+    # count, interleaved — round-robin picks then land shards on hosts in
+    # proportion to their device capacity (the north star's ICI-local
+    # placement: a 8-chip host gets 8x the shards of a 1-chip host).
+    dispatch_pool: list[str] = field(default_factory=list)
     query_stats: LatencyStats = field(default_factory=LatencyStats)
     shard_stats: LatencyStats = field(default_factory=LatencyStats)
+    # Per-member shard latency (leader-local observability — the
+    # reference's `jobs` report aggregated only per job).
+    member_stats: dict = field(default_factory=dict)
     _next_member: int = 0
     # --- in-flight bookkeeping (leader-local, never replicated) ---------
     next_offset: int = 0                      # reservation cursor
@@ -89,6 +97,7 @@ class Job:
             "assigned": list(self.assigned),
             "query_latency": self.query_stats.summary(),
             "shard_latency": self.shard_stats.summary(),
+            "member_latency": {m: s.summary() for m, s in self.member_stats.items()},
         }
 
     def to_wire(self) -> dict:
@@ -126,6 +135,7 @@ class JobScheduler:
         shard_size: int = 64,
         timer=None,
         shard_timeout_s: float = 120.0,
+        member_weight=None,
     ):
         import time
 
@@ -134,6 +144,10 @@ class JobScheduler:
         self.shard_size = int(shard_size)
         self.timer = timer or time.perf_counter
         self.shard_timeout_s = float(shard_timeout_s)
+        # addr -> chip count for ICI-local weighted placement (the north
+        # star's "per-host chip topology"); default: every host weight 1
+        # (the reference's uniform random pick, services.rs:414-416).
+        self.member_weight = member_weight or (lambda addr: 1)
         self.jobs: dict[str, Job] = {
             name: Job(model_name=name, queries=list(qs)) for name, qs in jobs.items()
         }
@@ -190,19 +204,29 @@ class JobScheduler:
 
     def assign_once(self) -> None:
         """Split active members evenly across running jobs, round-robin by
-        sorted index — the reference's 50/50 split generalized to K jobs."""
+        sorted index — the reference's 50/50 split generalized to K jobs.
+        Each job's dispatch pool repeats a member by its chip weight,
+        interleaved, so shard placement is proportional to capacity."""
         members = sorted(self.active_members())
+        weights = {m: max(1, int(self.member_weight(m))) for m in members}
         with self._lock:
             running = [n for n, j in self.jobs.items() if j.running and not j.done]
             for name, job in self.jobs.items():
                 if name not in running:
                     job.assigned = []
+                    job.dispatch_pool = []
             if not running:
                 return
             for i, name in enumerate(running):
-                self.jobs[name].assigned = [
+                job = self.jobs[name]
+                job.assigned = [
                     m for k, m in enumerate(members) if k % len(running) == i
                 ]
+                # Interleave by weight round: [a,b,a,b,a] for weights a=3,b=2.
+                pool: list[str] = []
+                for r in range(max((weights[m] for m in job.assigned), default=0)):
+                    pool.extend(m for m in job.assigned if weights[m] > r)
+                job.dispatch_pool = pool
 
     # ---- dispatch (services.rs:407-433, shard-ized) --------------------
 
@@ -224,7 +248,8 @@ class JobScheduler:
             else:
                 return None
             shard = job.queries[offset : offset + self.shard_size]
-            pool = [m for m in job.assigned if m not in excluded] or job.assigned
+            base = job.dispatch_pool or job.assigned
+            pool = [m for m in base if m not in excluded] or base
             member = pool[job._next_member % len(pool)]
             job._next_member += 1
             job.outstanding[offset] = member
@@ -268,15 +293,19 @@ class JobScheduler:
                     job.retry_q.append((offset, excluded | {member}))
             return 0
         elapsed = self.timer() - t0
-        return self._record_result(job, offset, shard, preds, elapsed)
+        return self._record_result(job, offset, shard, preds, elapsed, member)
 
-    def _record_result(self, job: Job, offset: int, shard, preds, elapsed: float) -> int:
+    def _record_result(
+        self, job: Job, offset: int, shard, preds, elapsed: float, member: str | None = None
+    ) -> int:
         """Buffer one shard result; flush the contiguous prefix. Returns
         #queries completed by this call (len(shard), or 0 for a duplicate)."""
         with self._lock:
             job.outstanding.pop(offset, None)
             if offset < job.finished or offset in job.buffered:
                 return 0  # duplicate (shard raced to two members)
+            if member is not None:
+                job.member_stats.setdefault(member, LatencyStats()).record(elapsed)
             job.buffered[offset] = (preds, elapsed)
             while job.finished in job.buffered:
                 p, dt = job.buffered.pop(job.finished)

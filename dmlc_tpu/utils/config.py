@@ -57,6 +57,10 @@ class ClusterConfig:
     dispatch_workers: int = 8
 
     # --- inference engine ---
+    # Chips on this host, for the leader's capacity-weighted shard
+    # placement (north star: "per-host chip topology ... ICI-local
+    # placement"). 0 = autodetect from jax when it is already loaded.
+    chips_per_host: int = 0
     batch_size: int = 256
     model_dtype: str = "bfloat16"
     data_dir: str = "test_files/imagenet_1k/train"

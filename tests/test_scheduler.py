@@ -440,3 +440,39 @@ def test_concurrent_crash_mid_run_keeps_exactly_once():
     job = sched.jobs["j"]
     assert job.finished == total
     assert job.correct == total  # exactly once: no double counts, no losses
+
+
+def test_chip_weighted_placement():
+    """A 4-chip host draws ~4x the shards of 1-chip hosts (north star:
+    ICI-local placement proportional to per-host chip topology)."""
+    net = SimRpcNetwork()
+    live = ["big", "small0", "small1"]
+    served = {m: 0 for m in live}
+
+    def backend_for(m):
+        def fn(synsets):
+            served[m] += 1
+            return echo_backend(synsets)
+
+        return fn
+
+    for m in live:
+        net.serve(m, PredictWorker({"j": backend_for(m)}).methods())
+    weights = {"big": 4, "small0": 1, "small1": 1}
+    sched = JobScheduler(
+        net.client("L"),
+        lambda: list(live),
+        jobs={"j": make_workload(24 * 8)},
+        shard_size=8,
+        member_weight=lambda addr: weights[addr],
+    )
+    sched.is_leading = True
+    sched._start({})
+    sched.run_to_completion()
+    job = sched.jobs["j"]
+    assert job.finished == 24 * 8
+    assert served["big"] == 16 and served["small0"] == 4 and served["small1"] == 4
+    # Per-member latency appears in the report.
+    rep = job.report()
+    assert set(rep["member_latency"]) == set(live)
+    assert rep["member_latency"]["big"]["count"] == 16
