@@ -146,8 +146,10 @@ def bench_e2e(model: str, batch_size: int, corpus_root: str) -> dict:
 
     # Size-suffixed root: a pre-existing corpus of another size can never
     # masquerade as RAW_SIZE (generate() reuses matching layouts blindly).
+    # Enough images for >=4 batches at the default batch size — a one-batch
+    # corpus cannot overlap anything and reports a meaningless speedup.
     data_dir, _ = corpus.generate(
-        Path(corpus_root) / str(RAW_SIZE), n_classes=128, images_per_class=2, size=RAW_SIZE
+        Path(corpus_root) / str(RAW_SIZE), n_classes=128, images_per_class=8, size=RAW_SIZE
     )
     paths = sorted(p for d in sorted(data_dir.iterdir()) for p in d.iterdir())
 
@@ -207,16 +209,27 @@ def main() -> None:
         default="resnet18,resnet50,vit_b16,clip_vit_l14",
         help="comma-separated registry models to bench (first is the headline)",
     )
-    parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument(
+        "--batch-size",
+        type=int,
+        default=None,
+        help="force ONE batch size for every config (default: 256, with the "
+        "headline ResNet-18 auto-tuned to 512)",
+    )
     parser.add_argument("--e2e", action="store_true", default=True)
     parser.add_argument("--no-e2e", dest="e2e", action="store_false")
     parser.add_argument("--corpus", default="bench_corpus")
     args = parser.parse_args()
 
     # Per-model batch tuning: the headline ResNet-18 runs fastest at 512
-    # (~30k img/s, MFU 0.52 vs ~26k at 256 — dispatch overhead amortizes);
-    # the heavier models stay at the default to bound p50 and compile time.
-    batch_overrides = {"resnet18": max(args.batch_size, 512)}
+    # (~29k img/s, MFU 0.50 vs ~26k at 256 — dispatch overhead amortizes);
+    # the heavier models stay at 256 to bound p50 and compile time. An
+    # explicit --batch-size wins everywhere (a dev slice that OOMs at 512
+    # must be able to force something smaller).
+    if args.batch_size is not None and args.batch_size <= 0:
+        parser.error("--batch-size must be positive")
+    base_batch = args.batch_size if args.batch_size is not None else 256
+    batch_overrides = {"resnet18": 512} if args.batch_size is None else {}
     models = [m.strip() for m in args.models.split(",") if m.strip()]
 
     def stderr_line(r: dict) -> None:
@@ -231,8 +244,19 @@ def main() -> None:
 
     # Headline FIRST, and its JSON line goes to stdout IMMEDIATELY: the
     # secondary configs and e2e below are best-effort extras, and a driver
-    # timeout mid-extras must not cost the recorded metric.
-    head = bench_model(models[0], batch_overrides.get(models[0], args.batch_size))
+    # timeout mid-extras must not cost the recorded metric. If the first
+    # model fails, the next successful one is promoted to headline rather
+    # than aborting with no metric at all.
+    head = None
+    remaining = list(models)
+    while remaining and head is None:
+        model = remaining.pop(0)
+        try:
+            head = bench_model(model, batch_overrides.get(model, base_batch))
+        except Exception as e:
+            print(f"[bench] {model} FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+    if head is None:
+        raise SystemExit("no model benched successfully")
     stderr_line(head)
     print(
         json.dumps(
@@ -249,10 +273,10 @@ def main() -> None:
     )
 
     results = [head]
-    for model in models[1:]:
+    for model in remaining:
         try:
             r = bench_model(
-                model, batch_overrides.get(model, args.batch_size), seconds=2.5, passes=1
+                model, batch_overrides.get(model, base_batch), seconds=2.5, passes=1
             )
         except Exception as e:
             print(f"[bench] {model} FAILED: {type(e).__name__}: {e}", file=sys.stderr)
@@ -263,7 +287,7 @@ def main() -> None:
     e2e = None
     if args.e2e:
         try:
-            e2e = bench_e2e(head["model"], args.batch_size, args.corpus)
+            e2e = bench_e2e(head["model"], base_batch, args.corpus)
             print(
                 f"[bench-e2e] {e2e['model']} images={e2e['images']} "
                 f"decode_only={e2e['decode_only_img_s']} img/s "

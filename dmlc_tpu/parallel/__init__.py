@@ -8,6 +8,7 @@ from dmlc_tpu.parallel.mesh import (
 )
 from dmlc_tpu.parallel.inference import BatchResult, InferenceEngine
 from dmlc_tpu.parallel.ring_attention import dense_attention, ring_attention
+from dmlc_tpu.parallel.ulysses import ulysses_attention
 from dmlc_tpu.parallel.train import (
     TrainState,
     create_train_state,

@@ -18,6 +18,7 @@ from dmlc_tpu.parallel import (
     make_train_step,
     param_spec,
     ring_attention,
+    ulysses_attention,
 )
 
 
@@ -144,3 +145,70 @@ class TestRingAttention:
         fn = partial(_ring_attention_local, axis_name="sp", causal=False, scale=q.shape[-1] ** -0.5)
         got = _jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5, rtol=1e-4)
+
+
+class TestUlyssesAttention:
+    """The all-to-all SP schedule must agree with dense attention and with
+    the ring schedule it complements."""
+
+    def _qkv(self, seed, b=2, h=8, s=64, d=16):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        mk = lambda k: jax.random.normal(k, (b, h, s, d), jnp.float32)
+        return mk(ks[0]), mk(ks[1]), mk(ks[2])
+
+    def test_matches_dense(self):
+        mesh = make_mesh({"sp": 8})
+        q, k, v = self._qkv(0)
+        ref = dense_attention(q, k, v)
+        got = ulysses_attention(q, k, v, mesh)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5, rtol=1e-4)
+
+    def test_matches_dense_causal(self):
+        mesh = make_mesh({"sp": 8})
+        q, k, v = self._qkv(1)
+        ref = dense_attention(q, k, v, causal=True)
+        got = ulysses_attention(q, k, v, mesh, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5, rtol=1e-4)
+
+    def test_sp_times_dp(self):
+        mesh = make_mesh({"dp": 2, "sp": 4})
+        q, k, v = self._qkv(2, b=4, s=32)
+        ref = dense_attention(q, k, v)
+
+        from functools import partial
+        import jax as _jax
+        from dmlc_tpu.parallel.ulysses import _ulysses_local
+
+        spec = P("dp", None, "sp", None)
+        fn = partial(_ulysses_local, axis_name="sp", causal=False, scale=q.shape[-1] ** -0.5)
+        got = _jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5, rtol=1e-4)
+
+    def test_grads_match_dense(self):
+        # The all_to_all pair must transpose correctly under AD.
+        mesh = make_mesh({"sp": 8})
+        q, k, v = self._qkv(3, s=32)
+
+        def loss_via(att, *args):
+            return jnp.sum(att(*args) ** 2)
+
+        ref_grads = jax.grad(lambda q, k, v: loss_via(dense_attention, q, k, v), argnums=(0, 1, 2))(q, k, v)
+        got_grads = jax.grad(
+            lambda q, k, v: loss_via(lambda *a: ulysses_attention(*a, mesh), q, k, v),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for g, r in zip(got_grads, ref_grads):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=3e-5, rtol=1e-4)
+
+    def test_matches_ring(self):
+        mesh = make_mesh({"sp": 8})
+        q, k, v = self._qkv(4)
+        a = ulysses_attention(q, k, v, mesh, causal=True)
+        b = ring_attention(q, k, v, mesh, causal=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5, rtol=1e-4)
+
+    def test_head_divisibility_checked(self):
+        mesh = make_mesh({"sp": 8})
+        q, k, v = self._qkv(5, h=4)  # 4 heads over sp=8: refused
+        with pytest.raises(ValueError, match="heads % sp"):
+            ulysses_attention(q, k, v, mesh)
