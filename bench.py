@@ -146,10 +146,13 @@ def bench_e2e(model: str, batch_size: int, corpus_root: str) -> dict:
 
     # Size-suffixed root: a pre-existing corpus of another size can never
     # masquerade as RAW_SIZE (generate() reuses matching layouts blindly).
-    # Enough images for >=4 batches at the default batch size — a one-batch
-    # corpus cannot overlap anything and reports a meaningless speedup.
+    # Enough images for >=2 batches at WHATEVER batch size this run uses —
+    # a one-batch corpus cannot overlap anything and reports a meaningless
+    # speedup. (Not more: every extra batch costs 5 timed passes over the
+    # remote tunnel, and the whole bench must fit the driver's timeout.)
+    per_class = max(4, -(-2 * batch_size // 128))
     data_dir, _ = corpus.generate(
-        Path(corpus_root) / str(RAW_SIZE), n_classes=128, images_per_class=8, size=RAW_SIZE
+        Path(corpus_root) / str(RAW_SIZE), n_classes=128, images_per_class=per_class, size=RAW_SIZE
     )
     paths = sorted(p for d in sorted(data_dir.iterdir()) for p in d.iterdir())
 
@@ -221,15 +224,16 @@ def main() -> None:
     parser.add_argument("--corpus", default="bench_corpus")
     args = parser.parse_args()
 
-    # Per-model batch tuning: the headline ResNet-18 runs fastest at 512
-    # (~29k img/s, MFU 0.50 vs ~26k at 256 — dispatch overhead amortizes);
-    # the heavier models stay at 256 to bound p50 and compile time. An
-    # explicit --batch-size wins everywhere (a dev slice that OOMs at 512
-    # must be able to force something smaller).
+    # Per-model batch tuning: the headline ResNet-18 runs fastest at 1024
+    # (measured 30.9k img/s MFU 0.53 @ 1024, vs 29.3k @ 512, 26k @ 256,
+    # 29.2k @ 2048 — 1024 is the knee of the batch curve); the heavier
+    # models stay at 256 to bound p50 and compile time. An explicit
+    # --batch-size wins everywhere (a dev slice that OOMs at 1024 must be
+    # able to force something smaller).
     if args.batch_size is not None and args.batch_size <= 0:
         parser.error("--batch-size must be positive")
     base_batch = args.batch_size if args.batch_size is not None else 256
-    batch_overrides = {"resnet18": 512} if args.batch_size is None else {}
+    batch_overrides = {"resnet18": 1024} if args.batch_size is None else {}
     models = [m.strip() for m in args.models.split(",") if m.strip()]
 
     def stderr_line(r: dict) -> None:

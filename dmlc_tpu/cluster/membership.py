@@ -8,8 +8,9 @@ Capability parity with the reference's membership layer (src/membership.rs):
 - failure detection: a neighbor silent for > failure_timeout is marked FAILED,
   with a one-round grace period for newly-adjacent neighbors
   (membership.rs:261-291)
-- anti-entropy merge: for a known id, newer last_active wins, ties prefer
-  FAILED; unknown ids are inserted (membership.rs:302-327)
+- anti-entropy merge: for a known id, newer last_active wins, ties resolve
+  by status rank (LEFT > FAILED > ACTIVE — a deterministic join, see
+  merge_entry); unknown ids are inserted (membership.rs:302-327)
 - join/welcome bootstrap with fast-rejoin: a joiner bumps its incarnation
   timestamp; the introducer fails stale same-address entries so the new
   incarnation supersedes them (membership.rs:113-123,185-214)
@@ -63,13 +64,23 @@ class Member:
         return cls(Status(w[0]), float(w[1]))
 
 
+# Tie-break rank for equal last_active: any non-ACTIVE verdict beats ACTIVE
+# (a failure can't be gossiped away by an equally-old ACTIVE copy), and LEFT
+# beats FAILED (a deliberate exit outranks a suspicion). The order must be
+# TOTAL: with a mere "non-ACTIVE wins" rule, two nodes holding FAILED@t and
+# LEFT@t adopt each other's verdict on every ping and never converge.
+_STATUS_RANK = {Status.ACTIVE: 0, Status.FAILED: 1, Status.LEFT: 2}
+
+
 def merge_entry(current: Member | None, incoming: Member) -> Member:
-    """Anti-entropy conflict resolution: newer last_active wins; on a tie the
-    FAILED/LEFT verdict sticks (so a failure can't be gossiped away by an
-    equally-old ACTIVE copy)."""
+    """Anti-entropy conflict resolution: newer last_active wins; ties resolve
+    by status rank — a deterministic join, so merge order can't matter."""
     if current is None or incoming.last_active > current.last_active:
         return incoming
-    if incoming.last_active == current.last_active and incoming.status != Status.ACTIVE:
+    if (
+        incoming.last_active == current.last_active
+        and _STATUS_RANK[incoming.status] > _STATUS_RANK[current.status]
+    ):
         return incoming
     return current
 

@@ -78,28 +78,88 @@ def cross_entropy(logits, labels):
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
-def make_train_step(mesh: Mesh, state: TrainState, dp_axis: str = "dp", tp_axis: str = "tp"):
+def make_train_step(
+    mesh: Mesh,
+    state: TrainState,
+    dp_axis: str = "dp",
+    tp_axis: str = "tp",
+    remat: bool = False,
+    grad_accum: int = 1,
+):
     """Returns (sharded_state, step_fn). step_fn(state, images_f32, labels) ->
-    (state, metrics). One compiled SPMD program; state is donated."""
+    (state, metrics). One compiled SPMD program; state is donated.
+
+    ``remat`` wraps the forward in ``jax.checkpoint``: activations are
+    recomputed during the backward pass instead of saved, trading ~1/3 more
+    FLOPs for O(sqrt)-ish activation memory — the standard TPU lever when a
+    model's activations outgrow HBM (the MXU is rarely the binding
+    constraint; HBM is).
+
+    ``grad_accum`` > 1 splits the global batch into that many microbatches
+    driven through a ``lax.scan`` (compiler-friendly: one compiled body, no
+    Python unrolling), accumulating gradients and updating once — the lever
+    for effective batch sizes whose activations don't fit even with remat.
+    The batch must split evenly, and each microbatch stays dp-sharded, so
+    ``batch % (grad_accum * dp) == 0``. BatchNorm stats chain through the
+    scan in microbatch order.
+    """
     shd = state_shardings(mesh, state, tp_axis)
     state = jax.tree_util.tree_map(jax.device_put, state, shd)
     data_shd = NamedSharding(mesh, P(dp_axis))
     label_shd = NamedSharding(mesh, P(dp_axis))
     has_bn = state.batch_stats is not None
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+
+    def loss_fn(params, batch_stats, apply_fn, images, labels):
+        variables = {"params": params}
+        if has_bn:
+            variables["batch_stats"] = batch_stats
+            logits, mut = apply_fn(variables, images, train=True, mutable=["batch_stats"])
+            return cross_entropy(logits, labels), (logits, mut["batch_stats"])
+        logits = apply_fn(variables, images, train=True)
+        return cross_entropy(logits, labels), (logits, None)
+
+    if remat:
+        # static_argnums: apply_fn is a function, not a traceable value.
+        loss_fn = jax.checkpoint(loss_fn, static_argnums=(2,))
 
     def step_fn(state: TrainState, images, labels):
-        def loss_fn(params):
-            variables = {"params": params}
-            if has_bn:
-                variables["batch_stats"] = state.batch_stats
-                logits, mut = state.apply_fn(variables, images, train=True, mutable=["batch_stats"])
-                return cross_entropy(logits, labels), (logits, mut["batch_stats"])
-            logits = state.apply_fn(variables, images, train=True)
-            return cross_entropy(logits, labels), (logits, None)
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        if grad_accum == 1:
+            (loss, (logits, new_bn)), grads = grad_fn(
+                state.params, state.batch_stats, state.apply_fn, images, labels
+            )
+            acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        else:
+            dp = mesh.shape.get(dp_axis, 1)
+            if images.shape[0] % (grad_accum * dp):
+                raise ValueError(
+                    f"batch {images.shape[0]} not divisible by "
+                    f"grad_accum={grad_accum} x dp={dp} (each microbatch "
+                    f"must still shard evenly over the dp axis)"
+                )
+            mb_images = images.reshape(grad_accum, -1, *images.shape[1:])
+            mb_labels = labels.reshape(grad_accum, -1)
 
-        (loss, (logits, new_bn)), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+            def micro(carry, mb):
+                bn, g_sum, loss_sum, acc_sum = carry
+                imgs, lbls = mb
+                (mb_loss, (logits, new_bn)), grads = grad_fn(
+                    state.params, bn, state.apply_fn, imgs, lbls
+                )
+                mb_acc = jnp.mean((jnp.argmax(logits, -1) == lbls).astype(jnp.float32))
+                g_sum = jax.tree_util.tree_map(jnp.add, g_sum, grads)
+                return (new_bn, g_sum, loss_sum + mb_loss, acc_sum + mb_acc), None
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+            zf = jnp.zeros((), jnp.float32)  # strong f32: scan carry types must match
+            (new_bn, g_sum, loss_sum, acc_sum), _ = jax.lax.scan(
+                micro, (state.batch_stats, zeros, zf, zf), (mb_images, mb_labels)
+            )
+            grads = jax.tree_util.tree_map(lambda g: g / grad_accum, g_sum)
+            loss, acc = loss_sum / grad_accum, acc_sum / grad_accum
         new_state = state.apply_gradients(grads, new_batch_stats=new_bn)
-        acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
         return new_state, {"loss": loss, "accuracy": acc}
 
     metric_shd = {"loss": NamedSharding(mesh, P()), "accuracy": NamedSharding(mesh, P())}

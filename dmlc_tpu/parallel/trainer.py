@@ -41,6 +41,8 @@ class TrainingDriver:
         data_fn: Callable[[int], tuple],
         checkpointer=None,
         checkpoint_every: int = 100,
+        remat: bool = False,
+        grad_accum: int = 1,
     ):
         self.mesh = mesh
         self.data_fn = data_fn
@@ -56,7 +58,9 @@ class TrainingDriver:
                 log.info("restored checkpoint at step %d", self.start_step)
             except Exception as e:  # no checkpoint yet — fresh run
                 log.info("no checkpoint to restore (%s); starting fresh", e)
-        self.state, self.step_fn = train_lib.make_train_step(mesh, state)
+        self.state, self.step_fn = train_lib.make_train_step(
+            mesh, state, remat=remat, grad_accum=grad_accum
+        )
 
     def run(self, steps: int) -> dict:
         """Train until the global step counter reaches ``start + steps``.

@@ -46,7 +46,13 @@ def generate(
     synset_path = root / "synset_words.txt"
     if synset_path.exists() and data_dir.exists():
         dirs = [d for d in data_dir.iterdir() if d.is_dir()]
-        if len(dirs) >= n_classes and all(any(d.iterdir()) for d in dirs[:n_classes]):
+        # Reuse only when BOTH dimensions match: a corpus with fewer images
+        # per class than requested would silently shrink whatever measurement
+        # asked for this shape (e.g. the bench's multi-batch overlap run).
+        if len(dirs) >= n_classes and all(
+            sum(1 for f in d.iterdir() if f.is_file()) >= images_per_class
+            for d in dirs[:n_classes]
+        ):
             return data_dir, synset_path
 
     write_synset_words(synset_path, n_classes)

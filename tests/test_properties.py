@@ -1,0 +1,90 @@
+"""Property-based tests (hypothesis) for the pure protocol cores.
+
+The reference validated its membership logic with 3 hand-picked unit tests
+and manual VM kills (SURVEY.md §4); here the merge rule and ring topology
+are pure functions, so their invariants can be checked over the whole input
+space. The key property: ``merge_entry`` is the join of a semilattice —
+idempotent, commutative, associative — which is exactly what anti-entropy
+gossip needs for every node to converge to the same membership view
+regardless of delivery order (the reference's merge, membership.rs:302-327,
+was never checked for this).
+"""
+
+from __future__ import annotations
+
+import hypothesis.strategies as st
+from hypothesis import given, settings
+
+from dmlc_tpu.cluster.membership import Member, Status, merge_entry
+from dmlc_tpu.utils.ring import symmetric_ring_neighbors
+
+members = st.builds(
+    Member,
+    status=st.sampled_from(list(Status)),
+    # A coarse grid on purpose: ties must be common enough to exercise the
+    # rank-based tie-break, not just the last_active comparison.
+    last_active=st.integers(min_value=0, max_value=3).map(float),
+)
+
+
+def join(a: Member, b: Member) -> Member:
+    return merge_entry(a, b)
+
+
+@given(members)
+def test_merge_idempotent(a):
+    assert join(a, a) == a
+
+
+@given(members, members)
+def test_merge_commutative(a, b):
+    assert join(a, b) == join(b, a)
+
+
+@given(members, members, members)
+def test_merge_associative(a, b, c):
+    assert join(join(a, b), c) == join(a, join(b, c))
+
+
+@given(members, st.lists(members, max_size=6), st.randoms())
+@settings(max_examples=200)
+def test_merge_order_free_convergence(seed, updates, rng):
+    """Folding any permutation of the same updates yields the same entry —
+    the end-to-end consequence of the semilattice laws for gossip."""
+    a = list(updates)
+    rng.shuffle(a)
+    acc_1, acc_2 = seed, seed
+    for x in updates:
+        acc_1 = join(acc_1, x)
+    for x in a:
+        acc_2 = join(acc_2, x)
+    assert acc_1 == acc_2
+
+
+@given(members, members)
+def test_merge_never_resurrects(a, b):
+    """An equally-fresh ACTIVE can never displace a FAILED/LEFT verdict."""
+    if a.status != Status.ACTIVE and b.status == Status.ACTIVE and b.last_active <= a.last_active:
+        assert join(a, b) == a
+
+
+ids = st.lists(
+    st.tuples(st.text(st.characters(codec="ascii"), min_size=1, max_size=8), st.floats(0, 10)),
+    min_size=1,
+    max_size=20,
+    unique=True,
+)
+
+
+@given(ids, st.integers(min_value=1, max_value=4), st.data())
+def test_ring_neighbor_invariants(all_ids, k, data):
+    me = data.draw(st.sampled_from(all_ids))
+    neighbors = symmetric_ring_neighbors(all_ids, me, k)
+    assert me not in neighbors
+    assert len(neighbors) == len(set(neighbors))
+    assert set(neighbors) <= set(all_ids)
+    assert len(neighbors) <= 2 * k
+    # Symmetry: with a shared view, neighborhood is mutual — the property
+    # the failure detector's "only judge your own neighbors" rule rests on.
+    for n in neighbors:
+        assert me in symmetric_ring_neighbors(all_ids, n, k)

@@ -33,9 +33,14 @@ def test_corpus_layout(small_corpus):
     assert len(words) == 12
     first = class_image_path(data_dir, words[0][0])
     assert first.suffix == ".jpg"
-    # Regeneration is a no-op on an existing corpus.
+    # Regeneration is a no-op on an existing corpus...
     again_dir, _ = corpus.generate(data_dir.parent, n_classes=12, images_per_class=2)
     assert again_dir == data_dir
+    # ...but a request for MORE images per class must regenerate, not
+    # silently hand back the smaller corpus.
+    grown_dir, _ = corpus.generate(data_dir.parent, n_classes=12, images_per_class=3, size=48)
+    grown = [p for d in sorted(grown_dir.iterdir()) for p in d.iterdir()]
+    assert len(grown) == 36
 
 
 def test_stream_matches_serial(small_corpus):
