@@ -150,9 +150,13 @@ def bench_e2e(model: str, batch_size: int, corpus_root: str) -> dict:
     # a one-batch corpus cannot overlap anything and reports a meaningless
     # speedup. (Not more: every extra batch costs 5 timed passes over the
     # remote tunnel, and the whole bench must fit the driver's timeout.)
-    per_class = max(4, -(-2 * batch_size // 128))
+    n_classes = 128
+    per_class = max(4, -(-2 * batch_size // n_classes))
     data_dir, _ = corpus.generate(
-        Path(corpus_root) / str(RAW_SIZE), n_classes=128, images_per_class=per_class, size=RAW_SIZE
+        Path(corpus_root) / str(RAW_SIZE),
+        n_classes=n_classes,
+        images_per_class=per_class,
+        size=RAW_SIZE,
     )
     paths = sorted(p for d in sorted(data_dir.iterdir()) for p in d.iterdir())
 
@@ -217,7 +221,7 @@ def main() -> None:
         type=int,
         default=None,
         help="force ONE batch size for every config (default: 256, with the "
-        "headline ResNet-18 auto-tuned to 512)",
+        "headline ResNet-18 auto-tuned to 1024)",
     )
     parser.add_argument("--e2e", action="store_true", default=True)
     parser.add_argument("--no-e2e", dest="e2e", action="store_false")
