@@ -61,6 +61,8 @@ Commands (reference: README.md:10-23):
   mesh-join                             join the fleet-wide jax.distributed mesh
   jobs                                  job status, accuracy, latency percentiles
   assign                                per-job member assignment table
+  trace on|off|summary|export <path>    span tracing: toggle, aggregate table,
+                                        Chrome trace JSON (chrome://tracing)
   help                                  this text
   exit | quit                           leave and stop the node
 """
@@ -190,6 +192,30 @@ class Cli:
                 for job, members in sorted(n.assignments().items())
             ]
             return format_table(["job", "#members", "members"], rows)
+        if cmd == "trace":
+            from dmlc_tpu.utils.tracing import tracer
+
+            sub = args[0] if args else "summary"
+            if sub == "on":
+                tracer.enabled = True
+                return "tracing enabled"
+            if sub == "off":
+                tracer.enabled = False
+                return "tracing disabled"
+            if sub == "export":
+                if len(args) != 2:
+                    return "usage: trace export <path>"
+                tracer.export(args[1])
+                return f"wrote Chrome trace to {args[1]} (open in chrome://tracing)"
+            if sub == "summary":
+                # format_latency already leads with n=<count>.
+                rows = [
+                    [name, format_latency(s)] for name, s in tracer.summary().items()
+                ]
+                if not rows:
+                    return "no spans recorded (is tracing on?)"
+                return format_table(["span", "latency"], rows)
+            return "usage: trace on|off|summary|export <path>"
         if cmd == "help":
             return HELP
         if cmd in ("exit", "quit"):

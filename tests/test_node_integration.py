@@ -131,6 +131,23 @@ def test_full_stack_through_cli(cluster3, tmp_path):
     out = cli.run_command("assign")
     assert "resnet18" in out
 
+    # trace verb: toggle, record through a traced path, summarize, export.
+    # finally-guarded: the tracer is process-global, and a failed assertion
+    # must not leave tracing on (or spans behind) for later tests.
+    from dmlc_tpu.utils.tracing import tracer
+
+    try:
+        assert "enabled" in cli.run_command("trace on")
+        cli.run_command(f"get models/resnet18 {tmp_path / 'traced.bin'}")
+        trace_path = tmp_path / "trace.json"
+        cli.run_command("trace summary")  # must not crash, spans optional here
+        assert "wrote Chrome trace" in cli.run_command(f"trace export {trace_path}")
+        assert trace_path.exists() and "traceEvents" in trace_path.read_text()
+        assert "disabled" in cli.run_command("trace off")
+    finally:
+        tracer.enabled = False
+        tracer.reset()
+
     # error surfaces, not crashes
     assert "error" in cli.run_command("get no/such/file /tmp/x")
     assert "unknown command" in cli.run_command("frobnicate")
