@@ -114,7 +114,13 @@ class InferenceEngine:
             return out
 
         param_shd = mesh_lib.param_shardings(self.mesh, self.variables)
-        self._forward = jax.jit(forward, in_shardings=(param_shd, data_shd), out_shardings=None)
+        # Outputs are pinned batch-sharded (not left to XLA): on a
+        # multi-host mesh each process reads back exactly its own rows via
+        # addressable shards (run_batch_global), which requires knowing the
+        # output sharding; on a single host this changes nothing.
+        out_shd = (data_shd, data_shd) if classifier else data_shd
+        self._data_sharding = data_shd
+        self._forward = jax.jit(forward, in_shardings=(param_shd, data_shd), out_shardings=out_shd)
 
     @property
     def input_size(self) -> int:
@@ -167,6 +173,60 @@ class InferenceEngine:
             idx, top = (np.asarray(o) for o in out)
             return BatchResult(idx[:n], top[:n], None, dt)
         emb = np.asarray(out)[:n]
+        return BatchResult(np.zeros(n, np.int32), np.zeros(n, np.float32), emb, dt)
+
+    def run_batch_global(self, local_u8: np.ndarray) -> BatchResult:
+        """Multi-host SPMD inference: every process calls this with its OWN
+        sub-batch; together they form one global batch over the mesh's dp
+        axis, one XLA program runs across all hosts (collectives over
+        ICI/DCN), and each process gets back results for the rows IT
+        contributed. Single-host this degenerates to run_batch.
+
+        The global batch shape stays static: each process pads its shard to
+        ``batch_size / process_count`` (so ``batch_size`` must divide evenly
+        by the process count). Row ownership follows
+        ``jax.make_array_from_process_local_data``: the global array is this
+        process's rows at its mesh positions, so the output's addressable
+        shards are exactly the answers to this process's inputs.
+        """
+        procs = jax.process_count()
+        local_cap = self.batch_size // procs
+        if self.batch_size % procs:
+            raise ValueError(
+                f"batch_size {self.batch_size} not divisible by {procs} processes"
+            )
+        n = local_u8.shape[0]
+        if n > local_cap:
+            raise ValueError(f"local batch {n} exceeds per-process share {local_cap}")
+        if n < local_cap:
+            # Pads even an EMPTY shard (dataset tail): every process must
+            # enter the collective forward or the others deadlock in it.
+            pad = np.zeros((local_cap - n, *local_u8.shape[1:]), local_u8.dtype)
+            local_u8 = np.concatenate([local_u8, pad])
+        t0 = time.perf_counter()
+        global_u8 = jax.make_array_from_process_local_data(self._data_sharding, local_u8)
+        out = jax.block_until_ready(self._forward(self.variables, global_u8))
+        dt = time.perf_counter() - t0
+        self._stats.record(dt)
+        tracer.record("device/forward_global", dt, model=self.spec.name, batch=int(n))
+
+        def local_rows(x) -> np.ndarray:
+            # Dedupe on batch index: with a tp axis this process addresses
+            # REPLICAS of its rows on several devices; concatenating them
+            # all would silently double rows.
+            seen: set = set()
+            rows = []
+            for s in sorted(x.addressable_shards, key=lambda s: (s.index[0].start or 0)):
+                key = s.index[0].start or 0
+                if key not in seen:
+                    seen.add(key)
+                    rows.append(np.asarray(s.data))
+            return np.concatenate(rows)
+
+        if self.spec.classifier:
+            idx, top = (local_rows(o) for o in out)
+            return BatchResult(idx[:n], top[:n], None, dt)
+        emb = local_rows(out)[:n]
         return BatchResult(np.zeros(n, np.int32), np.zeros(n, np.float32), emb, dt)
 
     def run_paths(self, paths: Sequence[str], workers: int | None = None) -> BatchResult:

@@ -73,6 +73,36 @@ def test_dp_inference_engine_resnet_small():
         registry._REGISTRY.pop("tiny_resnet", None)
 
 
+def test_run_batch_global_on_dp_tp_mesh():
+    """run_batch_global must return each row exactly once even when a tp
+    axis makes several REPLICAS of every output row addressable (the
+    single-process degenerate case still exercises the dedupe), and an
+    empty shard must still enter the collective and return cleanly."""
+    mesh = make_mesh({"dp": 4, "tp": 2})
+    model = resnet18(num_classes=16, dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False)
+
+    import dmlc_tpu.models.registry as registry
+
+    registry.register(
+        registry.ModelSpec("tiny_resnet_mh", lambda num_classes, dtype: model, 32, 16)
+    )
+    try:
+        eng = InferenceEngine(
+            "tiny_resnet_mh", mesh=mesh, variables=variables, dtype=jnp.float32, batch_size=16
+        )
+        batch = np.random.RandomState(1).randint(0, 255, (16, 32, 32, 3), np.uint8)
+        ref = eng.run_batch(batch)
+        got = eng.run_batch_global(batch)
+        np.testing.assert_array_equal(got.top1_index, ref.top1_index)
+        got5 = eng.run_batch_global(batch[:5])
+        np.testing.assert_array_equal(got5.top1_index, ref.top1_index[:5])
+        empty = eng.run_batch_global(batch[:0])
+        assert empty.top1_index.shape == (0,)
+    finally:
+        registry._REGISTRY.pop("tiny_resnet_mh", None)
+
+
 def test_train_step_vit_dp_tp():
     # dp=4 x tp=2: attention/MLP params sharded over tp, batch over dp.
     mesh = make_mesh({"dp": 4, "tp": 2})
