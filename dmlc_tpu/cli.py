@@ -175,11 +175,13 @@ class Cli:
         if cmd == "jobs":
             out = []
             for name, r in sorted(n.jobs_report().items()):
+                qps = r.get("throughput_qps", 0.0)
                 out.append(
                     f"{name}: {'RUNNING' if r['running'] else 'idle'} "
                     f"{r['finished']}/{r['total']} finished, "
                     f"accuracy {r['accuracy'] * 100:.2f}% "
                     f"({r['correct']}/{r['finished'] or 1})"
+                    + (f", {qps:.1f} queries/s" if qps else "")
                 )
                 out.append(f"  query latency: {format_latency(r['query_latency'])}")
                 out.append(f"  shard latency: {format_latency(r['shard_latency'])}")

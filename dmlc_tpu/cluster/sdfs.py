@@ -221,6 +221,7 @@ class SdfsLeader:
     def methods(self) -> dict:
         return {
             "sdfs.put": self._put,
+            "sdfs.put_inline": self._put_inline,
             "sdfs.get": self._get,
             "sdfs.get_versions": self._get_versions,
             "sdfs.delete": self._delete,
@@ -249,17 +250,37 @@ class SdfsLeader:
 
     # ---- RPC methods ---------------------------------------------------
 
-    def _put(self, p: dict) -> dict:
-        """Place a new version of ``name`` whose bytes are staged at
-        ``origin``. Returns {version, replicas}."""
-        name, origin = p["name"], p["origin"]
+    def _reserve_version(self, name: str) -> int:
+        """Hand out the next version for ``name`` under the lock — including
+        puts still in flight, so concurrent puts of one name can never share
+        a number. THE single home of this invariant (both put paths and the
+        failover reservation sync depend on it)."""
         with self._lock:
             self._require_leading()
             version = max(self.state.latest_version(name), self._reserved.get(name, 0)) + 1
             self._reserved[name] = version
+            return version
+
+    def _put(self, p: dict) -> dict:
+        """Place a new version of ``name`` whose bytes are staged at
+        ``origin``. Returns {version, replicas}."""
+        name, origin = p["name"], p["origin"]
+        version = self._reserve_version(name)
         replicas = self._place(
             name, version, source=origin, from_stage=True, stage_key=p.get("stage_key", name)
         )
+        if not replicas:
+            raise RpcError(f"no replicas stored {name!r} v{version}")
+        return {"version": version, "replicas": replicas}
+
+    def _put_inline(self, p: dict) -> dict:
+        """Place a new version whose bytes ride IN the request — for
+        standalone operator tools (tools/import_weights.py) that have no
+        member store to stage in. Same reservation + placement as _put;
+        the leader pushes the bytes to each chosen replica directly."""
+        name, data = p["name"], p["data"]
+        version = self._reserve_version(name)
+        replicas = self._place(name, version, source=None, from_stage=False, data=data)
         if not replicas:
             raise RpcError(f"no replicas stored {name!r} v{version}")
         return {"version": version, "replicas": replicas}
@@ -331,13 +352,16 @@ class SdfsLeader:
         self,
         name: str,
         version: int,
-        source: str,
+        source: str | None,
         from_stage: bool,
         stage_key: str | None = None,
+        data: bytes | None = None,
     ) -> list[str]:
-        """Copy (name, version) from ``source`` onto members chosen by
-        hash + linear probe until rf replicas exist. Unreachable candidates
-        are probed past, like failed scp targets (services.rs:367-394)."""
+        """Copy (name, version) onto members chosen by hash + linear probe
+        until rf replicas exist: pulled member-to-member from ``source``,
+        or pushed directly when the bytes arrived inline (``data``).
+        Unreachable candidates are probed past, like failed scp targets
+        (services.rs:367-394)."""
         with self._lock:
             have = set(self.state.replicas_of(name, version))
         live = self.active_members()
@@ -346,17 +370,24 @@ class SdfsLeader:
             if len(placed) >= self.rf:
                 break
             try:
-                self.rpc.call(
-                    candidate,
-                    "sdfs.replicate",
-                    {
-                        "name": name,
-                        "version": version,
-                        "source": source,
-                        "from_stage": from_stage,
-                        "stage_key": stage_key,
-                    },
-                )
+                if data is not None:
+                    self.rpc.call(
+                        candidate,
+                        "sdfs.receive",
+                        {"name": name, "version": version, "data": data},
+                    )
+                else:
+                    self.rpc.call(
+                        candidate,
+                        "sdfs.replicate",
+                        {
+                            "name": name,
+                            "version": version,
+                            "source": source,
+                            "from_stage": from_stage,
+                            "stage_key": stage_key,
+                        },
+                    )
             except (RpcUnreachable, RpcError) as e:
                 log.warning("replicate %s v%s -> %s failed: %s", name, version, candidate, e)
                 continue

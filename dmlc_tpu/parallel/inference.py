@@ -195,6 +195,27 @@ class InferenceEngine:
             raise ValueError(
                 f"batch_size {self.batch_size} not divisible by {procs} processes"
             )
+        # Precondition: the dp axis must PARTITION rows across processes.
+        # If another mesh axis (e.g. tp) spans processes instead, two
+        # processes would address the same rows while each feeds different
+        # data — make the failure a clear error here, not shard soup later.
+        if procs > 1 and "dp" in self.mesh.axis_names:
+            axis = self.mesh.axis_names.index("dp")
+            me = jax.process_index()
+            dp_coords = {
+                idx[axis]
+                for idx, dev in np.ndenumerate(self.mesh.devices)
+                if dev.process_index == me
+            }
+            dp_size = self.mesh.devices.shape[axis]
+            rows_owned = len(dp_coords) * (self.batch_size // dp_size)
+            if rows_owned != local_cap:
+                raise ValueError(
+                    f"mesh layout puts {rows_owned} batch rows on process {me} "
+                    f"but run_batch_global assumes {local_cap} (= batch/processes): "
+                    "the dp axis must partition rows by process — lay dp over "
+                    "processes (slowest-varying mesh axis), tp/sp within hosts"
+                )
         n = local_u8.shape[0]
         if n > local_cap:
             raise ValueError(f"local batch {n} exceeds per-process share {local_cap}")

@@ -69,6 +69,11 @@ class Job:
     outstanding: dict = field(default_factory=dict)   # offset -> member
     buffered: dict = field(default_factory=dict)      # offset -> (preds, elapsed)
     retry_q: list = field(default_factory=list)       # [(offset, excluded members)]
+    # Wall-clock throughput window (leader-local, this term only): first
+    # dispatch and latest completion stamps from the scheduler's timer.
+    first_dispatch_t: float | None = None
+    last_result_t: float | None = None
+    finished_at_start: int = 0                # cursor when this term began
 
     @property
     def done(self) -> bool:
@@ -86,6 +91,18 @@ class Job:
     def accuracy(self) -> float:
         return self.correct / self.finished if self.finished else 0.0
 
+    @property
+    def throughput_qps(self) -> float:
+        """Completed queries/second over this leadership term's dispatch
+        window (0.0 before any result). The reference reported only
+        latencies (main.rs:282-309); at shard scale the cluster rate is the
+        headline number, so it rides the jobs report too."""
+        if self.first_dispatch_t is None or self.last_result_t is None:
+            return 0.0
+        dt = self.last_result_t - self.first_dispatch_t
+        done = self.finished - self.finished_at_start
+        return done / dt if dt > 0 and done > 0 else 0.0
+
     def report(self) -> dict:
         return {
             "model": self.model_name,
@@ -94,6 +111,7 @@ class Job:
             "total": len(self.queries),
             "correct": self.correct,
             "accuracy": self.accuracy,
+            "throughput_qps": self.throughput_qps,
             "assigned": list(self.assigned),
             "query_latency": self.query_stats.summary(),
             "shard_latency": self.shard_stats.summary(),
@@ -118,6 +136,11 @@ class Job:
         self.query_stats = LatencyStats.from_wire(w["query_samples"])
         self.shard_stats = LatencyStats.from_wire(w["shard_samples"])
         self.reset_inflight()
+        # The throughput window is term-local: a new leader measures its own
+        # dispatch rate, not wall time since a dead leader's first shard.
+        self.first_dispatch_t = None
+        self.last_result_t = None
+        self.finished_at_start = self.finished
 
 
 class JobScheduler:
@@ -269,6 +292,9 @@ class JobScheduler:
         job = self.jobs[job_name]
         synsets = [s for s, _ in shard]
         t0 = self.timer()
+        with self._lock:
+            if job.first_dispatch_t is None:
+                job.first_dispatch_t = t0
         try:
             with tracer.span("scheduler/dispatch", job=job_name, member=member, n=len(shard)):
                 reply = self.rpc.call(
@@ -304,6 +330,7 @@ class JobScheduler:
             job.outstanding.pop(offset, None)
             if offset < job.finished or offset in job.buffered:
                 return 0  # duplicate (shard raced to two members)
+            job.last_result_t = self.timer()
             if member is not None:
                 job.member_stats.setdefault(member, LatencyStats()).record(elapsed)
             job.buffered[offset] = (preds, elapsed)

@@ -1,0 +1,61 @@
+"""tools/import_weights.py: external checkpoint -> validated blob.
+
+Uses the torchvision-layout TorchResNet18 from test_model_parity (the layout
+real torchvision checkpoints ship in) saved as a real ``torch.save`` file,
+so the tool's load -> convert -> validate -> serialize path runs end to end.
+"""
+
+import importlib.util
+import os
+import sys
+
+import numpy as np
+import pytest
+import torch
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location(
+        "import_weights", os.path.join(REPO_ROOT, "tools", "import_weights.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_import_tool_writes_valid_blob(tmp_path):
+    from test_model_parity import TorchResNet18
+
+    from dmlc_tpu.models import weights as weights_lib
+
+    torch.manual_seed(3)
+    ckpt = tmp_path / "resnet18.pth"
+    torch.save(TorchResNet18(num_classes=1000).state_dict(), ckpt)
+
+    out = tmp_path / "resnet18.blob"
+    tool = _load_tool()
+    rc = tool.main(["resnet18", str(ckpt), "--out", str(out)])
+    assert rc == 0
+
+    name, variables = weights_lib.weights_from_bytes(out.read_bytes(), expect_model="resnet18")
+    assert name == "resnet18"
+    fc = variables["params"]["head"]["kernel"]
+    assert np.shape(fc) == (512, 1000)
+
+
+def test_import_tool_loads_npz(tmp_path):
+    tool = _load_tool()
+    path = tmp_path / "weights.npz"
+    np.savez(path, a=np.ones((2, 2)), b=np.zeros(3))
+    sd = tool.load_state_dict(path)
+    assert set(sd) == {"a", "b"} and sd["a"].shape == (2, 2)
+
+
+def test_import_tool_requires_destination(tmp_path, capsys):
+    tool = _load_tool()
+    ckpt = tmp_path / "x.npz"
+    np.savez(ckpt, a=np.ones(1))
+    with pytest.raises(SystemExit):
+        tool.main(["resnet18", str(ckpt)])  # neither --leader nor --out

@@ -93,6 +93,8 @@ def test_run_to_completion_and_report():
         assert not r["running"]
         for k in ("mean", "median", "p90", "p95", "p99", "std"):
             assert k in r["query_latency"] and k in r["shard_latency"]
+        # Completed work over the fake timer's dispatch window.
+        assert r["throughput_qps"] > 0
     # Work spread across members: every member served at least one shard.
     assert all(c > 0 for c in f.calls.values())
 

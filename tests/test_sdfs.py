@@ -46,6 +46,23 @@ def cluster(tmp_path):
     return Cluster(tmp_path)
 
 
+def test_put_inline_places_rf_replicas(cluster):
+    """Bytes riding the request (standalone operator tools): same placement
+    and versioning as a staged put, interleaving with one correctly."""
+    reply = cluster.net.client("tool").call(
+        "L", "sdfs.put_inline", {"name": "models/x", "data": b"inline-1"}
+    )
+    assert reply["version"] == 1 and len(reply["replicas"]) == 4
+    for r in reply["replicas"]:
+        assert cluster.stores[r].read("models/x", 1) == b"inline-1"
+    # A staged put of the same name gets the NEXT version.
+    reply2 = cluster.client().put_bytes(b"staged-2", "models/x")
+    assert reply2["version"] == 2
+    # And the inline path is fetchable through the ordinary get.
+    version, data = cluster.client("m1").get_bytes("models/x", version=1)
+    assert (version, data) == (1, b"inline-1")
+
+
 def test_put_places_rf_replicas(cluster, tmp_path):
     src = tmp_path / "x.bin"
     src.write_bytes(b"payload-1")

@@ -1,0 +1,84 @@
+"""Import an external pretrained checkpoint and publish it to the cluster.
+
+The reference ships `.ot` weight files that every member loads at startup
+(/root/reference/src/services.rs:513-524) and re-broadcasts with `train`
+(services.rs:139-144). The equivalent operator flow here:
+
+    python tools/import_weights.py resnet18 resnet18.pth --leader host:8851
+
+1. load the external state dict (torch .pth / .npz of numpy arrays),
+2. convert to our Flax layout + validate shapes (models/weights.py,
+   models/convert.py — torchvision layouts for resnet/alexnet, HF layouts
+   for vit/clip),
+3. put the versioned blob into SDFS as ``models/<model>`` via the leader's
+   ``sdfs.put_inline`` (the bytes ride the request — a standalone tool has
+   no member store to stage in),
+4. then `train` in any node's REPL hot-swaps it into the live engines.
+
+Offline mode (--out FILE, no --leader): write the validated blob to a local
+file, to be `put` later from any node's CLI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+
+def load_state_dict(path: Path) -> dict:
+    """Accept a torch checkpoint (.pth/.pt/.bin) or a numpy .npz; return a
+    flat name -> numpy array dict."""
+    import numpy as np
+
+    if path.suffix == ".npz":
+        return dict(np.load(path))
+    import torch  # CPU torch is in the image; weights_only avoids pickle code
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    if hasattr(sd, "state_dict"):
+        sd = sd.state_dict()
+    if "state_dict" in sd and isinstance(sd["state_dict"], dict):
+        sd = sd["state_dict"]
+    return {k: v.numpy() if hasattr(v, "numpy") else np.asarray(v) for k, v in sd.items()}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("model", help="registry model name (resnet18, vit_b16, ...)")
+    parser.add_argument("checkpoint", type=Path, help=".pth/.pt/.bin/.npz state dict")
+    parser.add_argument("--leader", help="leader RPC address host:port to publish via")
+    parser.add_argument("--out", type=Path, help="write the blob locally instead")
+    args = parser.parse_args(argv)
+    if not args.leader and not args.out:
+        parser.error("need --leader (publish) or --out (local blob)")
+
+    from dmlc_tpu.models import weights as weights_lib
+
+    state_dict = load_state_dict(args.checkpoint)
+    variables = weights_lib.import_external(args.model, state_dict)
+    blob = weights_lib.weights_to_bytes(args.model, variables)
+    print(f"converted {args.checkpoint} -> {args.model} ({len(blob)} bytes, validated)")
+
+    if args.out:
+        args.out.write_bytes(blob)
+        print(f"wrote {args.out}; publish with: put {args.out} {weights_lib.sdfs_weights_name(args.model)}")
+        return 0
+
+    from dmlc_tpu.cluster.rpc import TcpRpc
+
+    # A standalone tool has no member store to stage bytes in, so the blob
+    # rides the request itself and the leader pushes it to the replicas.
+    reply = TcpRpc().call(
+        args.leader,
+        "sdfs.put_inline",
+        {"name": weights_lib.sdfs_weights_name(args.model), "data": blob},
+        timeout=300.0,
+    )
+    print(f"published v{reply['version']} to {sorted(reply['replicas'])}")
+    print("run `train` in any node's REPL to hot-swap it into the live engines")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
