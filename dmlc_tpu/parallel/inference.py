@@ -120,6 +120,30 @@ class InferenceEngine:
         # output sharding; on a single host this changes nothing.
         out_shd = (data_shd, data_shd) if classifier else data_shd
         self._data_sharding = data_shd
+        # Precomputed once (mesh and process layout are fixed for the
+        # engine's lifetime): does the dp axis PARTITION batch rows by
+        # process, as run_batch_global's row-ownership contract requires?
+        # None = fine; else the error to raise there.
+        self._global_batch_error: str | None = None
+        procs = jax.process_count()
+        if procs > 1 and "dp" in self.mesh.axis_names:
+            axis = self.mesh.axis_names.index("dp")
+            me = jax.process_index()
+            dp_coords = {
+                idx[axis]
+                for idx, dev in np.ndenumerate(self.mesh.devices)
+                if dev.process_index == me
+            }
+            dp_size = self.mesh.devices.shape[axis]
+            rows_owned = len(dp_coords) * (self.batch_size // dp_size)
+            if rows_owned != self.batch_size // procs:
+                self._global_batch_error = (
+                    f"mesh layout puts {rows_owned} batch rows on process {me} "
+                    f"but run_batch_global assumes {self.batch_size // procs} "
+                    "(= batch/processes): the dp axis must partition rows by "
+                    "process — lay dp over processes (slowest-varying mesh "
+                    "axis), tp/sp within hosts"
+                )
         self._forward = jax.jit(forward, in_shardings=(param_shd, data_shd), out_shardings=out_shd)
 
     @property
@@ -195,27 +219,8 @@ class InferenceEngine:
             raise ValueError(
                 f"batch_size {self.batch_size} not divisible by {procs} processes"
             )
-        # Precondition: the dp axis must PARTITION rows across processes.
-        # If another mesh axis (e.g. tp) spans processes instead, two
-        # processes would address the same rows while each feeds different
-        # data — make the failure a clear error here, not shard soup later.
-        if procs > 1 and "dp" in self.mesh.axis_names:
-            axis = self.mesh.axis_names.index("dp")
-            me = jax.process_index()
-            dp_coords = {
-                idx[axis]
-                for idx, dev in np.ndenumerate(self.mesh.devices)
-                if dev.process_index == me
-            }
-            dp_size = self.mesh.devices.shape[axis]
-            rows_owned = len(dp_coords) * (self.batch_size // dp_size)
-            if rows_owned != local_cap:
-                raise ValueError(
-                    f"mesh layout puts {rows_owned} batch rows on process {me} "
-                    f"but run_batch_global assumes {local_cap} (= batch/processes): "
-                    "the dp axis must partition rows by process — lay dp over "
-                    "processes (slowest-varying mesh axis), tp/sp within hosts"
-                )
+        if self._global_batch_error is not None:  # precomputed in __init__
+            raise ValueError(self._global_batch_error)
         n = local_u8.shape[0]
         if n > local_cap:
             raise ValueError(f"local batch {n} exceeds per-process share {local_cap}")

@@ -50,6 +50,52 @@ def top1_routing(logits: jax.Array, capacity: int):
     return dispatch, combine, aux
 
 
+def top2_routing(logits: jax.Array, capacity: int):
+    """GShard top-2 routing with per-expert capacity.
+
+    Each token goes to its two highest-gate experts (second choice masked
+    off the first), gates renormalized over the pair so kept tokens mix to
+    weight ~1. Second-choice tokens queue BEHIND every first-choice token
+    at the same expert (the GShard position offset), so under pressure the
+    primary assignment wins capacity. Returns (dispatch [T, E, C],
+    combine [T, E, C], aux_loss) like top1_routing.
+    """
+    t, e = logits.shape
+    if e < 2:
+        raise ValueError(f"top-2 routing needs >= 2 experts, got {e}")
+    gates = jax.nn.softmax(logits, axis=-1)                      # [T, E]
+    expert1 = jnp.argmax(gates, axis=-1)                          # [T]
+    mask1 = jax.nn.one_hot(expert1, e, dtype=logits.dtype)
+    gates_wo1 = jnp.where(mask1 > 0, -jnp.inf, gates)
+    expert2 = jnp.argmax(gates_wo1, axis=-1)
+    mask2 = jax.nn.one_hot(expert2, e, dtype=logits.dtype)
+
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - 1.0                # [T, E]
+    # Second choices queue after ALL first choices at that expert.
+    pos2 = (jnp.cumsum(mask2, axis=0) + mask1.sum(axis=0)[None, :]) * mask2 - 1.0
+
+    g1 = (gates * mask1).sum(-1)                                  # [T]
+    g2 = (gates * mask2).sum(-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    def build(mask, pos, gate):
+        kept = (pos >= 0) & (pos < capacity)
+        pos_oh = jax.nn.one_hot(
+            pos.max(axis=-1).astype(jnp.int32), capacity, dtype=logits.dtype
+        )
+        dispatch = mask[:, :, None] * pos_oh[:, None, :] * kept.max(axis=-1)[:, None, None]
+        return dispatch, dispatch * gate[:, None, None]
+
+    d1, c1 = build(mask1, pos1, g1)
+    d2, c2 = build(mask2, pos2, g2)
+    # Aux loss on the PRIMARY assignment (Switch/GShard convention).
+    density = mask1.mean(axis=0)
+    density_proxy = gates.mean(axis=0)
+    aux = (density * density_proxy).sum() * e
+    return d1 + d2, c1 + c2, aux
+
+
 class MoEMlp(nn.Module):
     """Expert-parallel MLP block: router -> E expert FFNs -> combine.
 
@@ -61,15 +107,26 @@ class MoEMlp(nn.Module):
     num_experts: int
     hidden_dim: int
     capacity_factor: float = 1.25
+    # 1 = Switch-style single expert per token; 2 = GShard top-2 (second
+    # choice queues behind first choices, gates renormalized per pair).
+    router_top_k: int = 1
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         t, d = x.shape
         e = self.num_experts
-        capacity = max(1, int(self.capacity_factor * t / e))
+        # Top-2 sends ~2x the tokens through experts; scale capacity with k
+        # so the drop rate stays comparable across router settings.
+        capacity = max(1, int(self.capacity_factor * self.router_top_k * t / e))
         router = nn.Dense(e, dtype=jnp.float32, param_dtype=jnp.float32, name="router")
-        dispatch, combine, aux = top1_routing(router(x.astype(jnp.float32)), capacity)
+        if self.router_top_k == 1:
+            routing = top1_routing
+        elif self.router_top_k == 2:
+            routing = top2_routing
+        else:
+            raise ValueError(f"router_top_k must be 1 or 2, got {self.router_top_k}")
+        dispatch, combine, aux = routing(router(x.astype(jnp.float32)), capacity)
         dispatch = dispatch.astype(self.dtype)
         combine = combine.astype(self.dtype)
 

@@ -13,6 +13,7 @@ from dmlc_tpu.parallel.moe import (
     moe_param_shardings,
     shard_moe_params,
     top1_routing,
+    top2_routing,
 )
 from dmlc_tpu.parallel.pipeline import (
     pipeline_apply,
@@ -137,6 +138,68 @@ class TestMoE:
         # must be exactly the residual input.
         unchanged = np.isclose(np.asarray(out), np.asarray(x)).all(axis=-1).sum()
         assert unchanged >= t - 2
+
+    def test_top2_routing_combine_sums_to_one(self):
+        # Ample capacity: every token reaches both choices, and renormalized
+        # pair gates must mix to weight ~1.
+        logits = jax.random.normal(jax.random.PRNGKey(8), (16, 4))
+        dispatch, combine, aux = top2_routing(logits, capacity=16)
+        per_token = np.asarray(dispatch.sum(axis=(1, 2)))
+        np.testing.assert_array_equal(per_token, 2.0 * np.ones(16))  # two slots each
+        np.testing.assert_allclose(np.asarray(combine.sum(axis=(1, 2))), 1.0, rtol=1e-5)
+        assert float(aux) > 0
+
+    def test_top2_second_choice_queues_behind_first(self):
+        # Expert 0 is everyone's first choice; expert 1 is token 3's first
+        # choice and the others' second. With capacity 2 at expert 1, token
+        # 3 (first choice) must keep its slot ahead of any second-choicers.
+        logits = jnp.array(
+            [[5.0, 1.0], [5.0, 1.0], [5.0, 1.0], [0.0, 5.0]], jnp.float32
+        )
+        dispatch, _, _ = top2_routing(logits, capacity=2)
+        d = np.asarray(dispatch)
+        assert d[3, 1].sum() == 1.0, "first-choice token lost its slot"
+        # Only ONE of tokens 0-2 fits into expert 1's remaining slot.
+        assert d[:3, 1].sum() == 1.0
+
+    def test_moe_top2_matches_dense_mixture(self):
+        """With ample capacity, top-2 output = residual + g1*FFN_1 + g2*FFN_2
+        with pair-renormalized gates."""
+        t, d, h, e = 16, 8, 16, 4
+        layer = MoEMlp(num_experts=e, hidden_dim=h, capacity_factor=4.0, router_top_k=2)
+        x = jax.random.normal(jax.random.PRNGKey(9), (t, d))
+        variables = layer.init(jax.random.PRNGKey(10), x)
+        out = np.asarray(layer.apply(variables, x))
+
+        params = jax.tree_util.tree_map(np.asarray, variables["params"])
+        logits = x @ params["router"]["kernel"] + params["router"]["bias"]
+        gates = np.asarray(jax.nn.softmax(logits, -1))
+        order = np.argsort(-gates, axis=-1)
+        for i in range(t):
+            e1, e2 = int(order[i, 0]), int(order[i, 1])
+            g1, g2 = gates[i, e1], gates[i, e2]
+            g1, g2 = g1 / (g1 + g2), g2 / (g1 + g2)
+            ffn = lambda eidx: np.asarray(
+                jax.nn.gelu(x[i] @ params["w_in"][eidx]) @ params["w_out"][eidx]
+            )
+            want = np.asarray(x[i]) + g1 * ffn(e1) + g2 * ffn(e2)
+            np.testing.assert_allclose(out[i], want, rtol=2e-4, atol=2e-4)
+
+    def test_moe_top2_trains_under_ep_mesh(self):
+        mesh = mesh_lib.make_mesh({"ep": 4, "dp": 2})
+        t, d, h, e = 64, 8, 16, 4
+        layer = MoEMlp(num_experts=e, hidden_dim=h, router_top_k=2)
+        x = jax.random.normal(jax.random.PRNGKey(11), (t, d))
+        y = jax.random.normal(jax.random.PRNGKey(12), (t, d))
+        variables = layer.init(jax.random.PRNGKey(13), x)
+        variables = shard_moe_params(mesh, variables)
+
+        @jax.jit
+        def loss_fn(v, x, y):
+            return jnp.mean((layer.apply(v, x) - y) ** 2)
+
+        grads = jax.jit(jax.grad(loss_fn))(variables, x, y)
+        assert all(bool(jnp.isfinite(g).all()) for g in jax.tree_util.tree_leaves(grads))
 
     def test_moe_trains_under_ep_mesh(self):
         mesh = mesh_lib.make_mesh({"ep": 4, "dp": 2})
