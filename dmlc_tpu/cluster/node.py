@@ -37,7 +37,12 @@ from dmlc_tpu.cluster.rpc import TcpRpc, TcpRpcServer
 from dmlc_tpu.cluster.sdfs import MemberStore, SdfsClient, SdfsLeader, SdfsMember
 from dmlc_tpu.cluster.transport import UdpTransport
 from dmlc_tpu.scheduler.jobs import JobScheduler
-from dmlc_tpu.scheduler.worker import EngineBackend, ModelLoader, PredictWorker
+from dmlc_tpu.scheduler.worker import (
+    EngineBackend,
+    ExportedBackend,
+    ModelLoader,
+    PredictWorker,
+)
 from dmlc_tpu.utils.config import ClusterConfig
 
 log = logging.getLogger(__name__)
@@ -70,10 +75,21 @@ class ClusterNode:
         self.store = MemberStore(Path(config.storage_dir))
         self.sdfs_member = SdfsMember(self.store, self.rpc)
         if backends is None:
-            backends = {
-                name: EngineBackend(name, config.data_dir, batch_size=config.batch_size)
-                for name in config.job_models
-            }
+            if config.serve_from_executable:
+                # sdfs is wired in below once the client exists (the member
+                # server needs the backends first); the backend is lazy, so
+                # nothing touches sdfs until warmup/first shard.
+                backends = {
+                    name: ExportedBackend(
+                        name, config.data_dir, sdfs=None, batch_size=config.batch_size
+                    )
+                    for name in config.job_models
+                }
+            else:
+                backends = {
+                    name: EngineBackend(name, config.data_dir, batch_size=config.batch_size)
+                    for name in config.job_models
+                }
         self.worker = PredictWorker(backends)
         self.model_loader = ModelLoader(self.store, self.worker.backends)
         methods = {
@@ -103,6 +119,9 @@ class ClusterNode:
         self.sdfs = SdfsClient(
             self.rpc, self.tracker.current, self.store, self.self_member_addr
         )
+        for backend in self.worker.backends.values():
+            if isinstance(backend, ExportedBackend) and backend.sdfs is None:
+                backend.sdfs = self.sdfs
 
         # BASELINE "SDFS shard" config: members with no local corpus resolve
         # class images through the replicated store, cached on local disk.
@@ -221,8 +240,17 @@ class ClusterNode:
 
             native.ensure_built()  # compile off the hot path, before serving
             for backend in self.worker.backends.values():
-                if hasattr(backend, "warmup"):
+                if not hasattr(backend, "warmup"):
+                    continue
+                try:
                     backend.warmup()
+                except Exception:
+                    # Best-effort: an ExportedBackend on a FRESH cluster has
+                    # nothing to fetch yet (the artifact is published by the
+                    # running cluster's `export` verb) — it must not kill
+                    # bootstrap. The backend stays lazy and builds on the
+                    # first shard instead.
+                    log.exception("eager warmup failed; backend will build lazily")
         self._spawn(self._membership_loop)
         self._spawn(self._probe_loop)
         if self.is_candidate:

@@ -27,6 +27,16 @@ log = logging.getLogger(__name__)
 PredictFn = Callable[[Sequence[str]], list[int]]
 
 
+def _resolve_paths(image_source, data_dir: Path, synsets: Sequence[str]) -> list[Path]:
+    """Synsets -> local image paths: through the SDFS-backed source when
+    wired, else the local fixture-corpus layout (shared by both backends)."""
+    from dmlc_tpu.ops import preprocess as pp
+
+    if image_source is not None:
+        return list(image_source(synsets))
+    return [pp.class_image_path(data_dir, s) for s in synsets]
+
+
 class PredictWorker:
     """RPC surface for shard prediction over a registry of models."""
 
@@ -92,14 +102,9 @@ class EngineBackend:
         return self._engine
 
     def __call__(self, synsets: Sequence[str]) -> list[int]:
-        from dmlc_tpu.ops import preprocess as pp
-
         with self._lock:
             engine = self._ensure_engine()
-            if self.image_source is not None:
-                paths = list(self.image_source(synsets))
-            else:
-                paths = [pp.class_image_path(self.data_dir, s) for s in synsets]
+            paths = _resolve_paths(self.image_source, self.data_dir, synsets)
             if len(paths) <= self.batch_size:
                 result = engine.run_paths(paths)
             else:
@@ -113,6 +118,100 @@ class EngineBackend:
         `train` verb — the reference reloads .ot files, services.rs:513-524)."""
         with self._lock:
             self._ensure_engine().load_variables(variables)
+
+
+class ExportedBackend:
+    """Serve shards from the SDFS-distributed StableHLO artifact + weights —
+    NO model source code on the serving path. This is the deployed form of
+    the native-serving contract (models/export.py): everything a member
+    needs to answer ``job.predict`` is two SDFS files, ``executables/<m>``
+    and ``models/<m>``. Weights absent from SDFS fall back to the registry's
+    random init (exactly EngineBackend's behavior before `train`), and
+    `train` hot-swaps them through ``load_variables`` like any backend.
+    """
+
+    def __init__(
+        self,
+        model_name: str,
+        data_dir: str | Path,
+        sdfs,
+        batch_size: int = 256,
+        image_source=None,
+    ):
+        self.model_name = model_name
+        self.data_dir = Path(data_dir)
+        self.sdfs = sdfs
+        self.batch_size = batch_size
+        self.image_source = image_source
+        self._server = None
+        self._lock = threading.Lock()
+
+    def warmup(self) -> None:
+        with self._lock:
+            self._ensure_server()
+
+    def _ensure_server(self):
+        if self._server is None:
+            import jax
+            import numpy as np
+
+            from dmlc_tpu.cluster.rpc import RpcUnreachable
+            from dmlc_tpu.models import export as export_lib
+            from dmlc_tpu.models import weights as weights_lib
+            from dmlc_tpu.models.registry import get_model
+
+            spec = get_model(self.model_name)
+            version, exported = export_lib.fetch_executable(self.sdfs, self.model_name)
+            try:
+                _, blob = self.sdfs.get_bytes(weights_lib.sdfs_weights_name(self.model_name))
+                # Validation errors (corrupt/mismatched blob) PROPAGATE —
+                # weights.py's contract is fail-at-load, never serve them.
+                _, variables = weights_lib.weights_from_bytes(blob, expect_model=self.model_name)
+                log.info("%s: artifact v%d + SDFS weights", self.model_name, version)
+            except RpcUnreachable:
+                raise  # transient (failover mid-fetch): retry the shard, not random-init
+            except RpcError as e:
+                if "not in SDFS" not in str(e):
+                    raise  # any refusal other than not-published is not consent
+                _, variables = spec.init_params(jax.random.PRNGKey(0), dtype=jax.numpy.float32)
+                variables = jax.tree_util.tree_map(np.asarray, variables)
+                log.info("%s: artifact v%d, weights not published yet — random init", self.model_name, version)
+            self._server = export_lib.ExportedServer(
+                exported, variables, self.batch_size, classifier=spec.classifier
+            )
+            self._input_size = spec.input_size
+        return self._server
+
+    def __call__(self, synsets: Sequence[str]) -> list[int]:
+        import concurrent.futures
+
+        from dmlc_tpu.ops import preprocess as pp
+
+        with self._lock:
+            server = self._ensure_server()
+            paths = _resolve_paths(self.image_source, self.data_dir, synsets)
+            starts = list(range(0, len(paths), self.batch_size))
+            preds: list[int] = []
+            # Decode chunk i+1 while the artifact executes chunk i (the same
+            # overlap EngineBackend gets from run_paths_stream).
+            with concurrent.futures.ThreadPoolExecutor(max_workers=1) as decoder:
+                decode = lambda s: pp.load_batch(
+                    paths[s : s + self.batch_size], size=self._input_size
+                )
+                fut = decoder.submit(decode, starts[0])
+                for i, s in enumerate(starts):
+                    batch = fut.result()
+                    if i + 1 < len(starts):
+                        fut = decoder.submit(decode, starts[i + 1])
+                    idx, _ = server(batch)
+                    preds.extend(int(x) for x in idx)
+            return preds
+
+    def load_variables(self, variables) -> None:
+        """The `train` verb's hot-swap: same validated tree the engine path
+        takes, handed to the artifact executor."""
+        with self._lock:
+            self._ensure_server().variables = variables
 
 
 class ModelLoader:

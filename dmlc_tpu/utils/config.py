@@ -76,6 +76,11 @@ class ClusterConfig:
     # reference's eager model load, src/services.rs:513-524). Lazy loading
     # risks compile-time GIL holds starving the heartbeat threads.
     eager_load: bool = True
+    # Serve shards from the SDFS-distributed StableHLO artifact
+    # (executables/<model>, published with the `export` verb) instead of
+    # building the model from source — the native-serving deployment shape
+    # (models/export.py): members need only the artifact + weights blobs.
+    serve_from_executable: bool = False
 
     # --- multi-host global device mesh (parallel/multihost.py) ---
     # >1 enables leader-coordinated jax.distributed bootstrap: members call

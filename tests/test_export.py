@@ -82,3 +82,49 @@ def test_executable_through_sdfs_and_served(tinynet_blob, tmp_path):
     assert idx.shape == (5,)
     assert list(idx) == [5] * 5
     assert np.all(top > 1.0 / N_CLASSES)
+
+
+def test_exported_backend_serves_shards_from_sdfs(tinynet_blob, tmp_path):
+    """The deployed native-serving shape (node's serve_from_executable):
+    a member backend answers job.predict shards with ONLY the SDFS artifact
+    + weights blobs — no model class on the serving path — and the `train`
+    hot-swap measurably changes its predictions."""
+    import jax
+
+    from dmlc_tpu.cluster.rpc import SimRpcNetwork
+    from dmlc_tpu.cluster.sdfs import MemberStore, SdfsClient, SdfsLeader, SdfsMember
+    from dmlc_tpu.scheduler.worker import ExportedBackend, PredictWorker
+    from dmlc_tpu.utils import corpus
+
+    net = SimRpcNetwork()
+    stores = {}
+    live = ["m0", "m1"]
+    for m in live:
+        stores[m] = MemberStore(tmp_path / m)
+        net.serve(m, SdfsMember(stores[m], net.client(m)).methods())
+    net.serve(
+        "L", SdfsLeader(net.client("L"), lambda: list(live), replication_factor=2).methods()
+    )
+    client = SdfsClient(net.client("m0"), "L", stores["m0"], "m0")
+    client.put_bytes(bytes(tinynet_blob), export_lib.sdfs_executable_name("tinynet"))
+
+    # Weights forcing constant class 5, published like `train` expects.
+    template = weights_lib.variables_template("tinynet")
+    variables = jax.tree_util.tree_map(lambda s: np.zeros(s.shape, s.dtype), template)
+    variables["params"]["head"]["bias"][5] = 9.0
+    weights_lib.publish_weights(client, "tinynet", variables)
+
+    data_dir, _ = corpus.generate(tmp_path / "corpus", n_classes=3, images_per_class=1, size=32)
+    backend = ExportedBackend("tinynet", data_dir, client, batch_size=8)
+    worker = PredictWorker({"tinynet": backend})
+    reply = worker._predict(
+        {"model": "tinynet", "synsets": ["n00000000", "n00000001", "n00000002"]}
+    )
+    assert reply["predictions"] == [5, 5, 5]
+
+    # Hot-swap (the member side of `train`): class 2 now wins everywhere.
+    variables["params"]["head"]["bias"][5] = 0.0
+    variables["params"]["head"]["bias"][2] = 9.0
+    backend.load_variables(variables)
+    reply = worker._predict({"model": "tinynet", "synsets": ["n00000001"]})
+    assert reply["predictions"] == [2]
