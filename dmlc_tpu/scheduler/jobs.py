@@ -66,9 +66,10 @@ class Job:
     _next_member: int = 0
     # --- in-flight bookkeeping (leader-local, never replicated) ---------
     next_offset: int = 0                      # reservation cursor
-    outstanding: dict = field(default_factory=dict)   # offset -> member
+    outstanding: dict = field(default_factory=dict)   # offset -> {members in flight}
     buffered: dict = field(default_factory=dict)      # offset -> (preds, elapsed)
     retry_q: list = field(default_factory=list)       # [(offset, excluded members)]
+    failed: dict = field(default_factory=dict)        # offset -> {members that failed it}
     # Wall-clock throughput window (leader-local, this term only): first
     # dispatch and latest completion stamps from the scheduler's timer.
     first_dispatch_t: float | None = None
@@ -86,6 +87,7 @@ class Job:
         self.outstanding.clear()
         self.buffered.clear()
         self.retry_q.clear()
+        self.failed.clear()
 
     @property
     def accuracy(self) -> float:
@@ -159,6 +161,7 @@ class JobScheduler:
         timer=None,
         shard_timeout_s: float = 120.0,
         member_weight=None,
+        hedge_tail: bool = True,
     ):
         import time
 
@@ -167,6 +170,13 @@ class JobScheduler:
         self.shard_size = int(shard_size)
         self.timer = timer or time.perf_counter
         self.shard_timeout_s = float(shard_timeout_s)
+        # Tail hedging (backup requests): once a job has no fresh shards to
+        # reserve, idle dispatchers re-send the oldest still-outstanding
+        # shard to a DIFFERENT member instead of sleeping — one straggler
+        # can no longer hold the job's completion hostage for its full
+        # latency (or the shard timeout). Safe by construction: results
+        # dedup by offset, so the slow and the hedge answer count once.
+        self.hedge_tail = bool(hedge_tail)
         # addr -> chip count for ICI-local weighted placement (the north
         # star's "per-host chip topology"); default: every host weight 1
         # (the reference's uniform random pick, services.rs:414-416).
@@ -254,28 +264,48 @@ class JobScheduler:
     # ---- dispatch (services.rs:407-433, shard-ized) --------------------
 
     def next_shard(self, job_name: str):
-        """Reserve the next shard (retries first) and pick its member.
-        Returns (member, offset, queries, excluded_members) or None if the
-        job is idle/starved/done-reserving. Safe under concurrent callers:
-        each reservation hands out a distinct offset."""
+        """Reserve the next shard (retries first, then fresh work, then —
+        with hedge_tail — a backup copy of the oldest outstanding shard on
+        a different member). Returns (member, offset, queries,
+        excluded_members) or None if the job is idle/starved/done. Safe
+        under concurrent callers: each reservation hands out a distinct
+        offset, and a hedge is sent at most once per offset."""
         with self._lock:
             job = self.jobs[job_name]
             if not job.running or not job.assigned:
                 return None
             excluded: set = set()
+            hedge = False
             if job.retry_q:
                 offset, excluded = job.retry_q.pop(0)
             elif job.next_offset < len(job.queries):
                 offset = job.next_offset
                 job.next_offset += self.shard_size
+            elif self.hedge_tail and job.outstanding:
+                # At most 2 copies in flight per offset; the backup avoids
+                # everyone currently running it AND everyone who failed it.
+                live = [
+                    (o, ms)
+                    for o, ms in sorted(job.outstanding.items())
+                    if o >= job.finished and o not in job.buffered and len(ms) < 2
+                ]
+                if not live:
+                    return None
+                offset, inflight = live[0]
+                excluded = set(inflight) | job.failed.get(offset, set())
+                hedge = True
             else:
                 return None
             shard = job.queries[offset : offset + self.shard_size]
             base = job.dispatch_pool or job.assigned
-            pool = [m for m in base if m not in excluded] or base
+            pool = [m for m in base if m not in excluded]
+            if not pool:
+                if hedge:
+                    return None  # nobody fresh to back it up with
+                pool = base
             member = pool[job._next_member % len(pool)]
             job._next_member += 1
-            job.outstanding[offset] = member
+            job.outstanding.setdefault(offset, set()).add(member)
             return member, offset, shard, excluded
 
     def dispatch_once(self, job_name: str) -> int:
@@ -313,13 +343,29 @@ class JobScheduler:
                 raise RpcError(f"{len(preds)} predictions for {len(shard)} queries")
         except (RpcUnreachable, RpcError) as e:
             log.warning("shard dispatch %s[%d] -> %s failed: %s", job_name, offset, member, e)
-            with self._lock:
-                job.outstanding.pop(offset, None)
-                if offset >= job.finished and offset not in job.buffered:
-                    job.retry_q.append((offset, excluded | {member}))
+            self._record_failure(job, offset, member, excluded)
             return 0
         elapsed = self.timer() - t0
         return self._record_result(job, offset, shard, preds, elapsed, member)
+
+    def _record_failure(self, job: Job, offset: int, member: str, excluded: set) -> None:
+        """One in-flight copy failed: drop just that member's tracking,
+        remember it in the shard's failure history, and requeue only when
+        NO copy is still in flight (a live hedge or original may yet
+        answer) and nothing has landed."""
+        with self._lock:
+            inflight = job.outstanding.get(offset)
+            if inflight is not None:
+                inflight.discard(member)
+                if not inflight:
+                    job.outstanding.pop(offset, None)
+            job.failed.setdefault(offset, set()).update(excluded | {member})
+            if (
+                offset not in job.outstanding
+                and offset >= job.finished
+                and offset not in job.buffered
+            ):
+                job.retry_q.append((offset, set(job.failed[offset])))
 
     def _record_result(
         self, job: Job, offset: int, shard, preds, elapsed: float, member: str | None = None
@@ -328,6 +374,7 @@ class JobScheduler:
         #queries completed by this call (len(shard), or 0 for a duplicate)."""
         with self._lock:
             job.outstanding.pop(offset, None)
+            job.failed.pop(offset, None)
             if offset < job.finished or offset in job.buffered:
                 return 0  # duplicate (shard raced to two members)
             job.last_result_t = self.timer()
@@ -357,7 +404,17 @@ class JobScheduler:
             return any(
                 j.running
                 and j.assigned
-                and (j.retry_q or j.next_offset < len(j.queries))
+                and (
+                    j.retry_q
+                    or j.next_offset < len(j.queries)
+                    or (
+                        self.hedge_tail
+                        and any(
+                            o >= j.finished and o not in j.buffered and len(ms) < 2
+                            for o, ms in j.outstanding.items()
+                        )
+                    )
+                )
                 for j in self.jobs.values()
             )
 

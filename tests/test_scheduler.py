@@ -373,7 +373,7 @@ def test_out_of_order_results_flush_as_contiguous_prefix():
     deadline = _time.monotonic() + 10
     while 0 not in job.outstanding and _time.monotonic() < deadline:
         _time.sleep(0.005)
-    assert job.outstanding.get(0) == "m0"
+    assert job.outstanding.get(0) == {"m0"}
 
     completed = sched.dispatch_once("j")  # offset 8 -> m1, completes first
     assert completed == 8  # completed work, but buffered behind the gap:
@@ -442,6 +442,81 @@ def test_concurrent_crash_mid_run_keeps_exactly_once():
     job = sched.jobs["j"]
     assert job.finished == total
     assert job.correct == total  # exactly once: no double counts, no losses
+
+
+def test_tail_hedging_backs_up_stragglers():
+    """Once fresh shards run out, idle dispatchers re-send the oldest
+    outstanding shard to a DIFFERENT member; whichever answer lands first
+    counts, the other is a dedup'd no-op — exactly once either way."""
+    f = Fixture(n_members=4, n_queries=32, shard=16)
+    f.scheduler._start({})
+    job = f.scheduler.jobs["resnet18"]
+
+    # Reserve both fresh shards without completing them (in flight).
+    first = f.scheduler.next_shard("resnet18")
+    second = f.scheduler.next_shard("resnet18")
+    assert first is not None and second is not None
+    assert job.next_offset >= len(job.queries)
+
+    # Next reservation is a HEDGE of the oldest outstanding offset, on a
+    # member other than the original assignee.
+    hedge = f.scheduler.next_shard("resnet18")
+    assert hedge is not None
+    h_member, h_offset, h_shard, h_excluded = hedge
+    assert h_offset == first[1]
+    assert h_member != first[0] and first[0] in h_excluded
+    # Two copies in flight max: the next idle reservation hedges the OTHER
+    # shard, and after that there is nothing left to hand out.
+    hedge2 = f.scheduler.next_shard("resnet18")
+    assert hedge2 is not None and hedge2[1] == second[1]
+    assert f.scheduler.next_shard("resnet18") is None
+
+    # Hedge answer lands first and counts; the straggler's late answer is a
+    # duplicate no-op.
+    preds = [int(s[1:]) for s, _ in h_shard]
+    assert f.scheduler._record_result(job, h_offset, h_shard, preds, 0.1, h_member) == len(h_shard)
+    assert f.scheduler._record_result(job, first[1], h_shard, preds, 9.9, first[0]) == 0
+    assert job.finished == len(h_shard) and job.correct == len(h_shard)
+
+
+def test_hedge_failure_bookkeeping_keeps_other_copy_alive():
+    """One copy failing must not forget the other in-flight copy, must not
+    requeue while it lives, and a later requeue excludes every member that
+    failed the shard."""
+    f = Fixture(n_members=8, n_queries=16, shard=16)  # 4 assigned per job
+    f.scheduler._start({})
+    job = f.scheduler.jobs["resnet18"]
+    original = f.scheduler.next_shard("resnet18")
+    hedge = f.scheduler.next_shard("resnet18")
+    offset = original[1]
+    assert hedge[1] == offset and job.outstanding[offset] == {original[0], hedge[0]}
+
+    # The ORIGINAL fails: the hedge stays tracked, nothing is requeued yet.
+    f.scheduler._record_failure(job, offset, original[0], original[3])
+    assert job.outstanding[offset] == {hedge[0]}
+    assert not job.retry_q
+    # Idle dispatchers may now back up the surviving copy again — but never
+    # on the member that already failed it.
+    rehedge = f.scheduler.next_shard("resnet18")
+    assert rehedge is not None and rehedge[1] == offset
+    assert rehedge[0] not in {original[0], hedge[0]}
+
+    # Everything in flight fails -> ONE requeue excluding all failed members.
+    f.scheduler._record_failure(job, offset, hedge[0], hedge[3])
+    assert not job.retry_q
+    f.scheduler._record_failure(job, offset, rehedge[0], rehedge[3])
+    assert len(job.retry_q) == 1
+    requeued_offset, excluded = job.retry_q[0]
+    assert requeued_offset == offset
+    assert {original[0], hedge[0], rehedge[0]} <= excluded
+
+
+def test_hedging_disabled_reserves_nothing_extra():
+    f = Fixture(n_members=4, n_queries=16, shard=16)
+    f.scheduler.hedge_tail = False
+    f.scheduler._start({})
+    assert f.scheduler.next_shard("resnet18") is not None
+    assert f.scheduler.next_shard("resnet18") is None  # no hedge branch
 
 
 def test_chip_weighted_placement():
