@@ -164,6 +164,7 @@ class ClusterNode:
             jobs={name: list(workload) for name in self.config.job_models},
             shard_size=self.config.dispatch_shard_size,
             member_weight=self._member_weight,
+            hedge_tail=self.config.hedge_tail,
         )
         methods = {**self.sdfs_leader.methods(), **self.scheduler.methods()}
         if self.config.mesh_processes > 1:

@@ -8,6 +8,11 @@ from dmlc_tpu.parallel.mesh import (
 )
 from dmlc_tpu.parallel.inference import BatchResult, InferenceEngine
 from dmlc_tpu.parallel.ring_attention import dense_attention, ring_attention
+from dmlc_tpu.parallel.sp_transformer import (
+    SPSelfAttention,
+    SPTransformerBlock,
+    SPTransformerLM,
+)
 from dmlc_tpu.parallel.ulysses import ulysses_attention
 from dmlc_tpu.parallel.train import (
     TrainState,

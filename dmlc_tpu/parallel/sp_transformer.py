@@ -1,0 +1,127 @@
+"""Sequence-parallel transformer blocks: long-context as a MODEL property.
+
+ring_attention / ulysses_attention are tensor-level schedules; this module
+makes them drop-in model components, so "long context" is not a kernel demo
+but a trainable architecture: activations stay sequence-sharded over ``sp``
+through the whole block (every other op — projections, MLP, layernorm,
+residuals — is position-wise, so XLA keeps them local to each device's
+sequence slice; only attention communicates, via the chosen schedule).
+
+With a {dp, sp} mesh the per-device activation footprint is
+O(B/dp * S/sp * D): sequences that cannot exist on one chip train across
+the ICI ring. Combine with remat/grad-accum (parallel/train.py) for the
+full long-context memory stack. The reference has no sequence axis anywhere
+(SURVEY.md §5); this is the TPU-first capability the north star asks for.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from dmlc_tpu.parallel.ring_attention import dense_attention, ring_attention
+from dmlc_tpu.parallel.ulysses import ulysses_attention
+
+_SCHEDULES = ("ring", "ulysses", "dense")
+
+
+class SPSelfAttention(nn.Module):
+    """Multi-head self-attention over a sequence sharded on ``mesh``'s sp
+    axis. ``schedule`` picks the communication pattern: "ring" (ppermute
+    K/V rotation, O(S/n) memory, no head constraint), "ulysses" (all-to-all
+    head/sequence reshard, needs heads % sp == 0), or "dense" (no sp —
+    single-device reference semantics, used for parity tests)."""
+
+    num_heads: int
+    mesh: Mesh | None = None
+    schedule: str = "ring"
+    causal: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):  # [B, S, D] (S sharded over sp)
+        if self.schedule not in _SCHEDULES:
+            raise ValueError(f"schedule must be one of {_SCHEDULES}, got {self.schedule!r}")
+        b, s, d = x.shape
+        if d % self.num_heads:
+            raise ValueError(f"model dim {d} not divisible by {self.num_heads} heads")
+        dh = d // self.num_heads
+
+        def heads(name):
+            y = nn.Dense(d, dtype=self.dtype, name=name)(x)
+            return y.reshape(b, s, self.num_heads, dh).transpose(0, 2, 1, 3)  # [B,H,S,Dh]
+
+        q, k, v = heads("query"), heads("key"), heads("value")
+        if self.schedule == "ring":
+            o = ring_attention(q, k, v, self.mesh, causal=self.causal)
+        elif self.schedule == "ulysses":
+            o = ulysses_attention(q, k, v, self.mesh, causal=self.causal)
+        else:
+            o = dense_attention(q, k, v, causal=self.causal)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
+        return nn.Dense(d, dtype=self.dtype, name="out")(o)
+
+
+class SPTransformerBlock(nn.Module):
+    """Pre-LN block: SP attention + position-wise MLP, both residual."""
+
+    num_heads: int
+    mlp_dim: int
+    mesh: Mesh | None = None
+    schedule: str = "ring"
+    causal: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        a = SPSelfAttention(
+            self.num_heads, self.mesh, self.schedule, self.causal, self.dtype, name="attn"
+        )(nn.LayerNorm(dtype=self.dtype, name="ln1")(x))
+        x = x + a
+        h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        h = nn.gelu(nn.Dense(self.mlp_dim, dtype=self.dtype, name="mlp_in")(h))
+        return x + nn.Dense(x.shape[-1], dtype=self.dtype, name="mlp_out")(h)
+
+
+class SPTransformerLM(nn.Module):
+    """A small causal LM over sequence-parallel blocks: token embed ->
+    N blocks -> tied-free head. Everything between attentions is
+    position-wise, so the sequence axis stays sp-sharded end to end."""
+
+    vocab: int
+    num_layers: int
+    num_heads: int
+    hidden: int
+    mlp_dim: int
+    max_len: int = 2048
+    mesh: Mesh | None = None
+    schedule: str = "ring"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):  # [B, S] int32
+        b, s = tokens.shape
+        if s > self.max_len:
+            # XLA gather would silently clamp out-of-range position indices
+            # to the last embedding — wrong positional signal, no error.
+            raise ValueError(f"sequence length {s} exceeds max_len {self.max_len}")
+        x = nn.Embed(self.vocab, self.hidden, dtype=self.dtype, name="embed")(tokens)
+        pos = nn.Embed(self.max_len, self.hidden, dtype=self.dtype, name="pos_embed")(
+            jnp.arange(s)[None, :]
+        )
+        x = x + pos  # position-wise: stays sp-sharded
+        for i in range(self.num_layers):
+            x = SPTransformerBlock(
+                self.num_heads,
+                self.mlp_dim,
+                self.mesh,
+                self.schedule,
+                causal=True,
+                dtype=self.dtype,
+                name=f"block{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        return nn.Dense(self.vocab, dtype=self.dtype, name="head")(x)  # [B, S, V]

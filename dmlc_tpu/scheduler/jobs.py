@@ -70,6 +70,7 @@ class Job:
     buffered: dict = field(default_factory=dict)      # offset -> (preds, elapsed)
     retry_q: list = field(default_factory=list)       # [(offset, excluded members)]
     failed: dict = field(default_factory=dict)        # offset -> {members that failed it}
+    dispatch_t: dict = field(default_factory=dict)    # offset -> first-dispatch stamp
     # Wall-clock throughput window (leader-local, this term only): first
     # dispatch and latest completion stamps from the scheduler's timer.
     first_dispatch_t: float | None = None
@@ -88,6 +89,7 @@ class Job:
         self.buffered.clear()
         self.retry_q.clear()
         self.failed.clear()
+        self.dispatch_t.clear()
 
     @property
     def accuracy(self) -> float:
@@ -176,7 +178,12 @@ class JobScheduler:
         # can no longer hold the job's completion hostage for its full
         # latency (or the shard timeout). Safe by construction: results
         # dedup by offset, so the slow and the hedge answer count once.
+        # A backup fires only after the shard has been in flight longer
+        # than hedge_factor x the job's MEDIAN shard latency (and never
+        # before any latency has been observed), so healthy tails don't
+        # double-compute their last shards.
         self.hedge_tail = bool(hedge_tail)
+        self.hedge_factor = 2.0
         # addr -> chip count for ICI-local weighted placement (the north
         # star's "per-host chip topology"); default: every host weight 1
         # (the reference's uniform random pick, services.rs:414-416).
@@ -263,13 +270,36 @@ class JobScheduler:
 
     # ---- dispatch (services.rs:407-433, shard-ized) --------------------
 
+    def _hedgeable_offset(self, job: Job):
+        """Oldest outstanding offset eligible for a backup request, or None.
+        Eligible: uncompleted, only one copy in flight, and in flight longer
+        than hedge_factor x the observed median shard latency (no hedging
+        before any latency has been observed — there is no evidence of
+        'slow' yet). Caller holds the lock."""
+        if not (self.hedge_tail and job.outstanding):
+            return None
+        stats = job.shard_stats.summary()
+        if not stats.get("count"):
+            return None
+        threshold = self.hedge_factor * stats["median"]
+        now = self.timer()
+        for o, ms in sorted(job.outstanding.items()):
+            if (
+                o >= job.finished
+                and o not in job.buffered
+                and len(ms) < 2
+                and now - job.dispatch_t.get(o, now) > threshold
+            ):
+                return o
+        return None
+
     def next_shard(self, job_name: str):
         """Reserve the next shard (retries first, then fresh work, then —
-        with hedge_tail — a backup copy of the oldest outstanding shard on
-        a different member). Returns (member, offset, queries,
+        with hedge_tail — a backup copy of a slow outstanding shard on a
+        different member). Returns (member, offset, queries,
         excluded_members) or None if the job is idle/starved/done. Safe
         under concurrent callers: each reservation hands out a distinct
-        offset, and a hedge is sent at most once per offset."""
+        offset, and at most 2 copies of an offset are in flight at once."""
         with self._lock:
             job = self.jobs[job_name]
             if not job.running or not job.assigned:
@@ -281,21 +311,15 @@ class JobScheduler:
             elif job.next_offset < len(job.queries):
                 offset = job.next_offset
                 job.next_offset += self.shard_size
-            elif self.hedge_tail and job.outstanding:
-                # At most 2 copies in flight per offset; the backup avoids
-                # everyone currently running it AND everyone who failed it.
-                live = [
-                    (o, ms)
-                    for o, ms in sorted(job.outstanding.items())
-                    if o >= job.finished and o not in job.buffered and len(ms) < 2
-                ]
-                if not live:
-                    return None
-                offset, inflight = live[0]
-                excluded = set(inflight) | job.failed.get(offset, set())
-                hedge = True
             else:
-                return None
+                picked = self._hedgeable_offset(job)
+                if picked is None:
+                    return None
+                offset = picked
+                # The backup avoids everyone currently running the shard
+                # AND everyone who already failed it.
+                excluded = set(job.outstanding[offset]) | job.failed.get(offset, set())
+                hedge = True
             shard = job.queries[offset : offset + self.shard_size]
             base = job.dispatch_pool or job.assigned
             pool = [m for m in base if m not in excluded]
@@ -306,6 +330,7 @@ class JobScheduler:
             member = pool[job._next_member % len(pool)]
             job._next_member += 1
             job.outstanding.setdefault(offset, set()).add(member)
+            job.dispatch_t.setdefault(offset, self.timer())
             return member, offset, shard, excluded
 
     def dispatch_once(self, job_name: str) -> int:
@@ -350,22 +375,22 @@ class JobScheduler:
 
     def _record_failure(self, job: Job, offset: int, member: str, excluded: set) -> None:
         """One in-flight copy failed: drop just that member's tracking,
-        remember it in the shard's failure history, and requeue only when
-        NO copy is still in flight (a live hedge or original may yet
-        answer) and nothing has landed."""
+        remember it (and only it — prior failures are already in the
+        history) in the shard's failure record, and requeue only when NO
+        copy is still in flight (a live hedge or original may yet answer)
+        and nothing has landed."""
         with self._lock:
             inflight = job.outstanding.get(offset)
             if inflight is not None:
                 inflight.discard(member)
                 if not inflight:
                     job.outstanding.pop(offset, None)
-            job.failed.setdefault(offset, set()).update(excluded | {member})
-            if (
-                offset not in job.outstanding
-                and offset >= job.finished
-                and offset not in job.buffered
-            ):
-                job.retry_q.append((offset, set(job.failed[offset])))
+                    job.dispatch_t.pop(offset, None)
+            if offset < job.finished or offset in job.buffered:
+                return  # a losing copy failing AFTER the offset completed
+            job.failed.setdefault(offset, set()).add(member)
+            if offset not in job.outstanding:
+                job.retry_q.append((offset, excluded | job.failed[offset]))
 
     def _record_result(
         self, job: Job, offset: int, shard, preds, elapsed: float, member: str | None = None
@@ -375,6 +400,7 @@ class JobScheduler:
         with self._lock:
             job.outstanding.pop(offset, None)
             job.failed.pop(offset, None)
+            job.dispatch_t.pop(offset, None)
             if offset < job.finished or offset in job.buffered:
                 return 0  # duplicate (shard raced to two members)
             job.last_result_t = self.timer()
@@ -407,13 +433,7 @@ class JobScheduler:
                 and (
                     j.retry_q
                     or j.next_offset < len(j.queries)
-                    or (
-                        self.hedge_tail
-                        and any(
-                            o >= j.finished and o not in j.buffered and len(ms) < 2
-                            for o, ms in j.outstanding.items()
-                        )
-                    )
+                    or self._hedgeable_offset(j) is not None
                 )
                 for j in self.jobs.values()
             )

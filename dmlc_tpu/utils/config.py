@@ -55,6 +55,9 @@ class ClusterConfig:
     # (the reference dispatched fire-and-forget, services.rs:418-421; here
     # in-flight work is bounded and tracked per shard offset).
     dispatch_workers: int = 8
+    # Backup-request the oldest outstanding shard on a second member once
+    # fresh work runs out (tail hedging; dedup makes it exactly-once).
+    hedge_tail: bool = True
 
     # --- inference engine ---
     # Chips on this host, for the leader's capacity-weighted shard

@@ -451,6 +451,11 @@ def test_tail_hedging_backs_up_stragglers():
     f = Fixture(n_members=4, n_queries=32, shard=16)
     f.scheduler._start({})
     job = f.scheduler.jobs["resnet18"]
+    # Latency evidence: hedging is gated on 2x the observed median shard
+    # latency (no evidence -> no hedge). The fake timer advances 5 ms per
+    # call, so anything beyond a 2 ms threshold is "slow".
+    for _ in range(5):
+        job.shard_stats.record(0.001)
 
     # Reserve both fresh shards without completing them (in flight).
     first = f.scheduler.next_shard("resnet18")
@@ -486,6 +491,8 @@ def test_hedge_failure_bookkeeping_keeps_other_copy_alive():
     f = Fixture(n_members=8, n_queries=16, shard=16)  # 4 assigned per job
     f.scheduler._start({})
     job = f.scheduler.jobs["resnet18"]
+    for _ in range(5):
+        job.shard_stats.record(0.001)  # latency evidence enabling hedges
     original = f.scheduler.next_shard("resnet18")
     hedge = f.scheduler.next_shard("resnet18")
     offset = original[1]
@@ -515,8 +522,25 @@ def test_hedging_disabled_reserves_nothing_extra():
     f = Fixture(n_members=4, n_queries=16, shard=16)
     f.scheduler.hedge_tail = False
     f.scheduler._start({})
+    f.scheduler.jobs["resnet18"].shard_stats.record(0.001)
     assert f.scheduler.next_shard("resnet18") is not None
     assert f.scheduler.next_shard("resnet18") is None  # no hedge branch
+
+
+def test_hedging_waits_for_latency_evidence():
+    """Without any observed shard latency — or before the in-flight copy is
+    actually slow — idle dispatchers must NOT duplicate work."""
+    f = Fixture(n_members=4, n_queries=16, shard=16)
+    f.scheduler._start({})
+    job = f.scheduler.jobs["resnet18"]
+    assert f.scheduler.next_shard("resnet18") is not None
+    # No latency evidence at all: no hedge.
+    assert f.scheduler.next_shard("resnet18") is None
+    assert f.scheduler.has_dispatchable() in (True, False)  # must not crash
+    # Evidence of a LONG median: the in-flight copy is not yet slow.
+    for _ in range(5):
+        job.shard_stats.record(100.0)
+    assert f.scheduler.next_shard("resnet18") is None
 
 
 def test_chip_weighted_placement():

@@ -1,0 +1,89 @@
+"""Sequence-parallel transformer: long-context as a trainable model.
+
+The SP schedules must be interchangeable INSIDE a model (same params, same
+logits), causal, and trainable end-to-end with the sequence axis sharded
+over sp on the virtual 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dmlc_tpu.parallel import make_mesh
+from dmlc_tpu.parallel.sp_transformer import SPTransformerLM
+
+VOCAB, LAYERS, HEADS, HIDDEN, MLP = 32, 2, 4, 32, 64
+B, S = 4, 32
+
+
+def build(mesh, schedule):
+    return SPTransformerLM(
+        vocab=VOCAB, num_layers=LAYERS, num_heads=HEADS, hidden=HIDDEN,
+        mlp_dim=MLP, max_len=S, mesh=mesh, schedule=schedule,
+    )
+
+
+@pytest.fixture(scope="module")
+def setup():
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (B, S), 0, VOCAB)
+    variables = build(None, "dense").init(jax.random.PRNGKey(1), tokens)
+    return mesh, tokens, variables
+
+
+def test_schedules_agree_inside_the_model(setup):
+    """Same params: dense, ring, and ulysses logits must match with the
+    sequence sharded over sp (dp x sp mesh)."""
+    mesh, tokens, variables = setup
+    want = np.asarray(build(None, "dense").apply(variables, tokens))
+    shd = NamedSharding(mesh, P("dp", "sp"))
+    tokens_sharded = jax.device_put(tokens, shd)
+    for schedule in ("ring", "ulysses"):
+        model = build(mesh, schedule)
+        got = np.asarray(jax.jit(model.apply)(variables, tokens_sharded))
+        np.testing.assert_allclose(got, want, atol=3e-5, rtol=1e-4)
+
+
+def test_causal(setup):
+    """Changing future tokens must not change past logits."""
+    _, tokens, variables = setup
+    model = build(None, "dense")
+    base = np.asarray(model.apply(variables, tokens))
+    mutated = tokens.at[:, S // 2 :].set((tokens[:, S // 2 :] + 1) % VOCAB)
+    out = np.asarray(model.apply(variables, mutated))
+    np.testing.assert_allclose(out[:, : S // 2], base[:, : S // 2], atol=1e-5)
+    assert not np.allclose(out[:, S // 2 :], base[:, S // 2 :])
+
+
+@pytest.mark.parametrize("schedule", ["ring", "ulysses"])
+def test_trains_sequence_parallel(setup, schedule):
+    """Next-token LM training with sequence sharded over sp: loss must
+    decrease on a fixed batch, grads stay finite, all under one jit."""
+    mesh, tokens, variables = setup
+    model = build(mesh, schedule)
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(variables)
+    shd = NamedSharding(mesh, P("dp", "sp"))
+    tokens = jax.device_put(tokens, shd)
+
+    def loss_fn(v, toks):
+        logits = model.apply(v, toks)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], toks[:, 1:]
+        ).mean()
+
+    @jax.jit
+    def step(v, opt_state, toks):
+        loss, grads = jax.value_and_grad(loss_fn)(v, toks)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(v, updates), opt_state, loss
+
+    losses = []
+    v = variables
+    for _ in range(5):
+        v, opt_state, loss = step(v, opt_state, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], f"no learning: {losses}"
