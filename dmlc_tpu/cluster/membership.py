@@ -7,7 +7,10 @@ Capability parity with the reference's membership layer (src/membership.rs):
   (membership.rs:225-259, utils.rs:5-21)
 - failure detection: a neighbor silent for > failure_timeout is marked FAILED,
   with a one-round grace period for newly-adjacent neighbors
-  (membership.rs:261-291)
+  (membership.rs:261-291) — hardened beyond the reference with SWIM-style
+  indirect probes: a suspect (silent past half the timeout) is ping-req'd
+  through other members, whose relayed acks ("ack2") count as liveness, so a
+  lossy direct link never produces a false FAILED verdict on its own
 - anti-entropy merge: for a known id, newer last_active wins, ties resolve
   by status rank (LEFT > FAILED > ACTIVE — a deterministic join, see
   merge_entry); unknown ids are inserted (membership.rs:302-327)
@@ -106,10 +109,16 @@ class MembershipNode:
         }
         self._prev_neighbors: set[NodeId] = set()
         # Failure detection runs on LOCAL receipt times, never on gossiped
-        # remote-clock stamps: when we hear a node directly (ping or ack) we
-        # stamp our own clock here. Gossiped last_active orders anti-entropy
-        # merges only. This makes detection latency independent of clock skew.
+        # remote-clock stamps: when we hear a node directly (ping, ack, or a
+        # relayed indirect ack) we stamp our own clock here. Gossiped
+        # last_active orders anti-entropy merges only. This makes detection
+        # latency independent of clock skew.
         self._last_heard: dict[NodeId, float] = {}
+        # SWIM-style indirect probing: target -> {requester addr: stamp} of
+        # ping-req relays we owe an ack2 forward for. Keyed by requester so
+        # a suspect re-probed every round yields ONE ack2 per requester,
+        # not one per round. Pruned past the failure timeout.
+        self._relay: dict[NodeId, dict[str, float]] = {}
         self._left = False
         # Deterministic per-node RNG for gossip sampling: reproducible sim
         # runs, distinct sequences across nodes.
@@ -182,15 +191,43 @@ class MembershipNode:
                 if n not in self._prev_neighbors:
                     self._last_heard[n] = now
             # Detector: only judge nodes that were already neighbors last
-            # round, and only on locally-stamped receipt times.
+            # round, and only on locally-stamped receipt times. A SUSPECT
+            # (silent past half the timeout) first gets indirect probes:
+            # ping-reqs to other members who ping it and relay its ack back
+            # (SWIM) — a lossy direct link then never becomes a false
+            # FAILED verdict, because evidence arrives via a third party.
             cutoff = now - self.config.failure_timeout_s
-            for n in self._prev_neighbors & set(neighbors):
+            suspect_cutoff = now - self.config.failure_timeout_s / 2
+            judged = self._prev_neighbors & set(neighbors)
+            r = self.config.indirect_probes
+            for n in judged:
                 m = self.members.get(n)
                 heard = self._last_heard.get(n, now)
-                if m is not None and m.status == Status.ACTIVE and heard < cutoff:
+                if m is None or m.status != Status.ACTIVE:
+                    continue
+                if heard < cutoff:
                     self._set(n, Member(Status.FAILED, m.last_active))
                     log.warning("%s: detected failure of %s", self.transport.address, n)
+                elif r > 0 and heard < suspect_cutoff:
+                    helpers = [
+                        i
+                        for i in self.members
+                        if i not in (n, self.self_id)
+                        and self.members[i].status == Status.ACTIVE
+                    ]
+                    self._rng.shuffle(helpers)
+                    for h in helpers[:r]:
+                        self.transport.send(
+                            h[0],
+                            {"t": "pingreq", "sender": list(self.self_id), "target": list(n)},
+                        )
             self._prev_neighbors = set(neighbors)
+            # Prune relay obligations nobody can satisfy anymore.
+            expiry = now - self.config.failure_timeout_s
+            for t in list(self._relay):
+                self._relay[t] = {a: s for a, s in self._relay[t].items() if s >= expiry}
+                if not self._relay[t]:
+                    del self._relay[t]
 
     def _neighbors(self) -> list[NodeId]:
         return symmetric_ring_neighbors(
@@ -245,6 +282,29 @@ class MembershipNode:
                 sender = (msg["sender"][0], msg["sender"][1])
                 self._last_heard[sender] = self.clock.now()  # direct evidence
                 self._merge_one(sender, Member(Status.ACTIVE, self.clock.now()))
+                # Relay the liveness proof to anyone whose ping-req for this
+                # node we served (the requester's direct link may be down —
+                # that is the whole point of asking us).
+                for requester in self._relay.pop(sender, {}):
+                    self.transport.send(
+                        requester, {"t": "ack2", "sender": list(self.self_id), "target": list(sender)}
+                    )
+            elif kind == "pingreq":
+                # Probe ``target`` on the requester's behalf: ping it now and
+                # owe the requester an ack2 when (if) it answers us.
+                requester = (msg["sender"][0], msg["sender"][1])
+                target = (msg["target"][0], msg["target"][1])
+                if target != self.self_id:
+                    self._relay.setdefault(target, {})[requester[0]] = self.clock.now()
+                    self._send_ping(target)
+                else:  # asked about ourselves: answer directly
+                    self.transport.send(requester[0], {"t": "ack", "sender": list(self.self_id)})
+            elif kind == "ack2":
+                # Indirect liveness: a helper heard ``target`` for us.
+                target = (msg["target"][0], msg["target"][1])
+                if target != self.self_id:
+                    self._last_heard[target] = self.clock.now()
+                    self._merge_one(target, Member(Status.ACTIVE, self.clock.now()))
             elif kind == "join":
                 joiner = (msg["sender"][0], msg["sender"][1])
                 # Fast-rejoin: any older incarnation at the same address is

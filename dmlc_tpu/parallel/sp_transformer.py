@@ -102,7 +102,7 @@ class SPTransformerLM(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):  # [B, S] int32
+    def __call__(self, tokens):  # [B, S] int32
         b, s = tokens.shape
         if s > self.max_len:
             # XLA gather would silently clamp out-of-range position indices

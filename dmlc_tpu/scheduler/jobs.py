@@ -278,10 +278,11 @@ class JobScheduler:
         'slow' yet). Caller holds the lock."""
         if not (self.hedge_tail and job.outstanding):
             return None
-        stats = job.shard_stats.summary()
-        if not stats.get("count"):
+        if not len(job.shard_stats):
             return None
-        threshold = self.hedge_factor * stats["median"]
+        # One percentile (one sort), not the full summary — this runs on the
+        # dispatcher threads' idle-poll path under the lock.
+        threshold = self.hedge_factor * job.shard_stats.percentile(50)
         now = self.timer()
         for o, ms in sorted(job.outstanding.items()):
             if (

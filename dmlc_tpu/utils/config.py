@@ -38,6 +38,11 @@ class ClusterConfig:
     # bounded random sample (self always included) keeps datagrams under the
     # UDP limit at any fleet size while anti-entropy still converges.
     gossip_max_entries: int = 64
+    # SWIM-style indirect probes: a neighbor silent past HALF the failure
+    # timeout gets ping-req'd through this many other members, whose relayed
+    # acks keep a node with a merely-lossy direct link from being falsely
+    # FAILED. 0 restores the reference's direct-only detector.
+    indirect_probes: int = 2
 
     # --- SDFS ---
     storage_dir: str = "storage"        # src/services.rs:34

@@ -71,6 +71,54 @@ def test_membership_chaos_converges(seed):
         assert view == first, f"{viewer} diverges from {alive[0]} (seed {seed})"
 
 
+class TestIndirectProbes:
+    """SWIM ping-req/ack2: link loss is not node death."""
+
+    def test_partitioned_pair_stays_active_via_helpers(self):
+        """Cut ONLY the a<->b link (every other path intact): with indirect
+        probes, liveness evidence relays through helpers and neither node
+        ever falsely FAILS the other."""
+        c = SimCluster(6, ring_k=2)
+        c.rounds(3)
+        a, b = "node1:8850", "node2:8850"  # ring-adjacent (sorted ids)
+        events: list = []
+        c.nodes[a].on_change = lambda nid, m: events.append((nid[0], m.status.value))
+        c.nodes[b].on_change = lambda nid, m: events.append((nid[0], m.status.value))
+        c.net.partition(a, b)
+        c.rounds(10)
+        # Not even a TRANSIENT false verdict in either direction.
+        assert (b, "failed") not in events and (a, "failed") not in events
+        assert c.statuses_seen_by(a)[b] == "active"
+        assert c.statuses_seen_by(b)[a] == "active"
+
+    def test_without_probes_link_loss_is_misread_as_death(self):
+        """The same scenario with indirect_probes=0 (the reference's
+        direct-only detector) false-positives — the behavior the probes
+        exist to fix."""
+        c = SimCluster(6, ring_k=2, indirect_probes=0)
+        c.rounds(3)
+        a, b = "node1:8850", "node2:8850"
+        events: list = []
+        c.nodes[a].on_change = lambda nid, m: events.append((nid[0], m.status.value))
+        c.net.partition(a, b)
+        c.rounds(10)
+        # The direct-only detector repeatedly (falsely) fails the peer; the
+        # verdict flaps because helpers' gossip resurrects it each round.
+        assert (b, "failed") in events
+
+    def test_crashed_node_still_detected_with_probes_on(self):
+        """Indirect probing must not mask real death: helpers get no acks
+        from a crashed node, so the timeout verdict stands."""
+        c = SimCluster(6, ring_k=2)
+        c.rounds(3)
+        victim = "node3:8850"
+        c.net.crash(victim)
+        c.rounds(8)
+        for viewer in c.nodes:
+            if viewer != victim:
+                assert c.statuses_seen_by(viewer)[victim] == "failed"
+
+
 @pytest.mark.parametrize("seed", range(4))
 def test_scheduler_chaos_exactly_once(seed):
     rng = random.Random(seed)
