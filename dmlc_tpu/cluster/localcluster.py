@@ -1,0 +1,112 @@
+"""Localhost cluster harness: N real nodes on 127.0.0.1 in one process.
+
+The reference could only be exercised by deploying to its 10-VM fleet; this
+module spins the REAL stack (UDP gossip, TCP RPC, maintenance threads) on
+loopback with compressed intervals — the shared engine behind the
+integration tests and the operator tools (tools/measure_failover.py), so
+port allocation, config compression, and readiness waits live in ONE place.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from pathlib import Path
+
+from dmlc_tpu.cluster.node import ClusterNode
+from dmlc_tpu.utils.config import ClusterConfig
+
+
+def wait_until(cond, timeout: float = 30.0, interval: float = 0.02, msg: str = "condition"):
+    """Poll ``cond`` until true or raise (the harness's only clock)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def make_synsets(path: Path, n: int) -> Path:
+    """A synset_words.txt with n synthetic classes (truth = line index)."""
+    path.write_text("".join(f"n{i:08d} label {i}\n" for i in range(n)))
+    return path
+
+
+def echo_backend(synsets):
+    """Fake model: predicts the class encoded in the synset id (always
+    right against make_synsets truth)."""
+    return [int(s[1:]) for s in synsets]
+
+
+def start_local_cluster(
+    tmp: Path,
+    n_nodes: int = 3,
+    backends=None,
+    n_leader_candidates: int = 2,
+    scale: float = 1.0,
+    join: bool = True,
+    **config_overrides,
+):
+    """Start ``n_nodes`` ClusterNodes on a random loopback port block.
+
+    Interval constants are the reference's, compressed 5x and multiplied by
+    ``scale`` (scale=5 restores the reference's 1 s heartbeat / 3 s loops).
+    ``backends`` is per-node {model: PredictFn} (shared), default the echo
+    backend for the configured job models. With ``join`` the fleet is
+    joined, converged, and the first leader promoted before returning.
+
+    Returns the node list; caller owns shutdown (``stop_local_cluster``).
+    """
+    base = random.randint(21000, 52000) // 10 * 10
+    candidates = [
+        f"127.0.0.1:{base + 10 * i + 1}" for i in range(n_leader_candidates)
+    ]
+    overrides = dict(config_overrides)
+    synset_path = overrides.pop("synset_path", None)
+    if synset_path is None:
+        synset_path = make_synsets(tmp / "synsets.txt", 40)
+    nodes = []
+    for i in range(n_nodes):
+        fields = dict(
+            host="127.0.0.1",
+            gossip_port=base + 10 * i,
+            leader_port=base + 10 * i + 1,
+            member_port=base + 10 * i + 2,
+            leader_candidates=candidates,
+            storage_dir=str(tmp / f"node{i}" / "storage"),
+            synset_path=str(synset_path),
+            replication_factor=min(2, n_nodes),
+            dispatch_shard_size=8,
+            heartbeat_interval_s=0.2 * scale,
+            failure_timeout_s=0.6 * scale,
+            rereplication_interval_s=0.6 * scale,
+            assignment_interval_s=0.6 * scale,
+            leader_probe_interval_s=0.6 * scale,
+        )
+        fields.update(overrides)  # caller overrides win over harness defaults
+        cfg = ClusterConfig(**fields)
+        node_backends = backends
+        if node_backends is None:
+            node_backends = {name: echo_backend for name in cfg.job_models}
+        node = ClusterNode(cfg, backends=node_backends)
+        node.start()
+        nodes.append(node)
+    if join:
+        for n in nodes[1:]:
+            n.join(nodes[0].gossip.address)
+        wait_until(
+            lambda: all(len(n.membership.active_ids()) == n_nodes for n in nodes),
+            msg=f"{n_nodes}-node membership convergence",
+        )
+        wait_until(lambda: nodes[0].standby.is_leader, msg="first-leader promotion")
+    return nodes
+
+
+def stop_local_cluster(nodes) -> None:
+    """Best-effort shutdown of every node (tolerates already-crashed ones)."""
+    for n in nodes:
+        try:
+            n.stop()
+        except Exception:
+            pass

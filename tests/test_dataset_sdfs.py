@@ -5,7 +5,6 @@ ordinary SDFS get path to serve predict shards (north star: "stages
 batches from the SDFS get path straight into HBM")."""
 
 import random
-import time
 
 import numpy as np
 import pytest
@@ -17,13 +16,7 @@ from dmlc_tpu.utils.config import ClusterConfig
 from tiny_model import N_CLASSES
 
 
-def wait_until(cond, timeout=30.0, interval=0.05, msg="condition"):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return
-        time.sleep(interval)
-    raise AssertionError(f"timed out waiting for {msg}")
+from dmlc_tpu.cluster.localcluster import wait_until  # shared harness
 
 
 def make_corpus(tmp_path, n):

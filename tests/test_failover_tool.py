@@ -1,0 +1,26 @@
+"""tools/measure_failover.py: the reference's VM-kill experiment, automated.
+
+One real trial (3 localhost nodes, leader crashed mid-run): the tool must
+report a finite detection/resume time and ZERO lost or wrong queries."""
+
+import importlib.util
+import json
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_failover_trial_exactly_once(tmp_path, capsys):
+    spec = importlib.util.spec_from_file_location(
+        "measure_failover", os.path.join(REPO_ROOT, "tools", "measure_failover.py")
+    )
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+
+    rc = tool.main(["--trials", "1", "--queries", "600"])
+    assert rc == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    r = json.loads(line)
+    assert r["wrong"] == 0
+    assert 0 < r["detection_s"] < 10
+    assert r["detection_s"] <= r["resume_s"] < 15

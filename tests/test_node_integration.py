@@ -6,77 +6,23 @@ Fake inference backends keep this hermetic (no JAX); the real EngineBackend
 path is covered by bench.py on hardware.
 """
 
-import random
-import time
-
 import pytest
 
 from dmlc_tpu.cli import Cli
-from dmlc_tpu.cluster.node import ClusterNode
-from dmlc_tpu.utils.config import ClusterConfig
-
-
-def wait_until(cond, timeout=15.0, interval=0.05, msg="condition"):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return
-        time.sleep(interval)
-    raise AssertionError(f"timed out waiting for {msg}")
-
-
-def make_synsets(tmp_path, n=40):
-    path = tmp_path / "synsets.txt"
-    path.write_text("".join(f"n{i:08d} label {i}\n" for i in range(n)))
-    return path
+from dmlc_tpu.cluster.localcluster import (
+    start_local_cluster,
+    stop_local_cluster,
+    wait_until,
+)
 
 
 @pytest.fixture
 def cluster3(tmp_path):
-    """3 nodes on 127.0.0.1 with the fleet port layout (offsets 0/+1/+2)."""
-    base = random.randint(21000, 52000) // 10 * 10
-    synset_path = make_synsets(tmp_path)
-    nodes = []
-    leader_candidates = [f"127.0.0.1:{base + 1}", f"127.0.0.1:{base + 11}"]
-
-    def fake_backend(synsets):
-        return [int(s[1:]) for s in synsets]  # always right
-
-    for i in range(3):
-        cfg = ClusterConfig(
-            host="127.0.0.1",
-            gossip_port=base + 10 * i,
-            leader_port=base + 10 * i + 1,
-            member_port=base + 10 * i + 2,
-            leader_candidates=leader_candidates,
-            storage_dir=str(tmp_path / f"node{i}" / "storage"),
-            synset_path=str(synset_path),
-            replication_factor=2,
-            dispatch_shard_size=8,
-            heartbeat_interval_s=0.1,
-            failure_timeout_s=0.5,
-            rereplication_interval_s=0.2,
-            assignment_interval_s=0.2,
-            leader_probe_interval_s=0.2,
-        )
-        node = ClusterNode(
-            cfg, backends={"resnet18": fake_backend, "alexnet": fake_backend}
-        )
-        node.start()
-        nodes.append(node)
-    # Nodes 1,2 join via node 0.
-    for n in nodes[1:]:
-        n.join(nodes[0].gossip.address)
-    wait_until(
-        lambda: all(len(n.membership.active_ids()) == 3 for n in nodes),
-        msg="3-node membership convergence",
-    )
-    # Leadership is claimed via the standby loop, not assumed at boot; the
-    # CLI verbs need an active leader.
-    wait_until(lambda: nodes[0].standby.is_leader, msg="first-leader promotion")
+    """3 real nodes on 127.0.0.1 via the shared harness (echo backends,
+    joined + converged + first leader promoted)."""
+    nodes = start_local_cluster(tmp_path, n_nodes=3)
     yield nodes
-    for n in nodes:
-        n.stop()
+    stop_local_cluster(nodes)
 
 
 def test_full_stack_through_cli(cluster3, tmp_path):

@@ -13,7 +13,6 @@ A tiny registered model ("tinynet") keeps the real-JAX path fast on CPU.
 """
 
 import random
-import time
 
 import jax
 import numpy as np
@@ -91,13 +90,7 @@ def test_engine_load_variables_changes_predictions():
 # ---------------------------------------------------------------------------
 
 
-def wait_until(cond, timeout=30.0, interval=0.05, msg="condition"):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return
-        time.sleep(interval)
-    raise AssertionError(f"timed out waiting for {msg}")
+from dmlc_tpu.cluster.localcluster import wait_until  # shared harness
 
 
 @pytest.fixture
