@@ -67,6 +67,19 @@ def start_local_cluster(
     if synset_path is None:
         synset_path = make_synsets(tmp / "synsets.txt", 40)
     nodes = []
+    try:
+        return _start_all(tmp, n_nodes, base, candidates, synset_path, overrides,
+                          backends, scale, join, nodes)
+    except Exception:
+        # A half-started fleet (port collision, convergence timeout) must
+        # not leak bound ports and heartbeat threads into the caller, who
+        # never got a handle to stop them.
+        stop_local_cluster(nodes)
+        raise
+
+
+def _start_all(tmp, n_nodes, base, candidates, synset_path, overrides,
+               backends, scale, join, nodes):
     for i in range(n_nodes):
         fields = dict(
             host="127.0.0.1",

@@ -25,15 +25,17 @@ from jax.sharding import Mesh
 from dmlc_tpu.parallel.ring_attention import dense_attention, ring_attention
 from dmlc_tpu.parallel.ulysses import ulysses_attention
 
-_SCHEDULES = ("ring", "ulysses", "dense")
+_SCHEDULES = ("ring", "ulysses", "dense", "flash")
 
 
 class SPSelfAttention(nn.Module):
     """Multi-head self-attention over a sequence sharded on ``mesh``'s sp
     axis. ``schedule`` picks the communication pattern: "ring" (ppermute
     K/V rotation, O(S/n) memory, no head constraint), "ulysses" (all-to-all
-    head/sequence reshard, needs heads % sp == 0), or "dense" (no sp —
-    single-device reference semantics, used for parity tests)."""
+    head/sequence reshard, needs heads % sp == 0), "dense" (no sp —
+    single-device reference semantics, used for parity tests), or "flash"
+    (no sp — the blockwise Pallas kernel, ops/pallas_kernels.py: O(S)
+    memory and faster than dense on TPU for the single-device regime)."""
 
     num_heads: int
     mesh: Mesh | None = None
@@ -59,6 +61,10 @@ class SPSelfAttention(nn.Module):
             o = ring_attention(q, k, v, self.mesh, causal=self.causal)
         elif self.schedule == "ulysses":
             o = ulysses_attention(q, k, v, self.mesh, causal=self.causal)
+        elif self.schedule == "flash":
+            from dmlc_tpu.ops.pallas_kernels import flash_attention
+
+            o = flash_attention(q, k, v, causal=self.causal)
         else:
             o = dense_attention(q, k, v, causal=self.causal)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, d)

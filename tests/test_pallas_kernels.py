@@ -39,3 +39,63 @@ def test_softmax_top1_extreme_logits_stable():
     idx, prob = softmax_top1(logits)
     assert int(idx[0]) == 0
     assert np.isfinite(float(prob[0])) and 0 < float(prob[0]) <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# flash attention
+# ---------------------------------------------------------------------------
+
+
+def _qkv(seed, b=2, h=2, s=256, d=64, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (b, h, s, d), dtype) for k in ks)
+
+
+def test_flash_attention_matches_dense():
+    from dmlc_tpu.ops.pallas_kernels import flash_attention
+    from dmlc_tpu.parallel.ring_attention import dense_attention
+
+    q, k, v = _qkv(0)
+    want = np.asarray(dense_attention(q, k, v))
+    got = np.asarray(flash_attention(q, k, v))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)
+
+
+def test_flash_attention_causal_matches_dense():
+    from dmlc_tpu.ops.pallas_kernels import flash_attention
+    from dmlc_tpu.parallel.ring_attention import dense_attention
+
+    q, k, v = _qkv(1, s=256)
+    want = np.asarray(dense_attention(q, k, v, causal=True))
+    got = np.asarray(flash_attention(q, k, v, causal=True))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)
+
+
+def test_flash_attention_bf16():
+    from dmlc_tpu.ops.pallas_kernels import flash_attention
+    from dmlc_tpu.parallel.ring_attention import dense_attention
+
+    q, k, v = _qkv(2, s=128, dtype=jnp.bfloat16)
+    want = np.asarray(dense_attention(q, k, v, causal=True)).astype(np.float32)
+    got = np.asarray(flash_attention(q, k, v, causal=True)).astype(np.float32)
+    np.testing.assert_allclose(got, want, atol=3e-2, rtol=3e-2)
+
+
+def test_flash_attention_short_sequence_shrinks_blocks():
+    from dmlc_tpu.ops.pallas_kernels import flash_attention
+    from dmlc_tpu.parallel.ring_attention import dense_attention
+
+    q, k, v = _qkv(3, s=32, d=16)
+    want = np.asarray(dense_attention(q, k, v))
+    got = np.asarray(flash_attention(q, k, v))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)
+
+
+def test_flash_attention_rejects_indivisible_sequence():
+    import pytest
+
+    from dmlc_tpu.ops.pallas_kernels import flash_attention
+
+    q, k, v = _qkv(4, s=192, d=16)  # 192 % 128 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        flash_attention(q, k, v)
