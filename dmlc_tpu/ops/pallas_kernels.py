@@ -148,6 +148,34 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_k: int, causal: bool, scale
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _flash(causal, scale, blk_q, blk_k, q, k, v):
+    return _flash_forward(causal, scale, blk_q, blk_k, q, k, v)
+
+
+def _flash_vjp_fwd(causal, scale, blk_q, blk_k, q, k, v):
+    return _flash_forward(causal, scale, blk_q, blk_k, q, k, v), (q, k, v)
+
+
+def _flash_vjp_bwd(causal, scale, blk_q, blk_k, res, g):
+    """Backward = exact gradients by recomputing through the DENSE path
+    (one [S, S] scratch per batch-head in the backward only): the kernel's
+    O(S) memory win applies to inference and the forward pass; a blockwise
+    backward kernel is the remaining step if training at S near the memory
+    ceiling — at which point ring attention (fully differentiable, O(S/n))
+    is the supported route."""
+    from dmlc_tpu.parallel.ring_attention import dense_attention
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: dense_attention(q, k, v, causal=causal, scale=scale), q, k, v
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
 def flash_attention(q, k, v, *, causal: bool = False, scale: float | None = None,
                     blk_q: int = 128, blk_k: int = 128):
     """Blockwise (flash) attention: [B, H, S, Dh] q/k/v -> [B, H, S, Dh].
@@ -169,18 +197,30 @@ def flash_attention(q, k, v, *, causal: bool = False, scale: float | None = None
 
     Requires S divisible by the block sizes (shrunk automatically for short
     sequences); pad the sequence or pick divisible blocks otherwise.
+
+    Differentiable: the backward recomputes exact gradients through the
+    dense path (see _flash_vjp_bwd for the memory trade-off), so the kernel
+    drops into trainable models (SPSelfAttention schedule="flash").
     """
-    b, h, s, dh = q.shape
+    s, dh = q.shape[2], q.shape[3]
     blk_q = min(blk_q, s)
     blk_k = min(blk_k, s)
     if s % blk_q or s % blk_k:
         raise ValueError(f"sequence {s} not divisible by blocks ({blk_q}, {blk_k})")
     if scale is None:
         scale = dh**-0.5
+    return _flash(causal, float(scale), blk_q, blk_k, q, k, v)
+
+
+def _flash_forward(causal, scale, blk_q, blk_k, q, k, v):
+    b, h, s, dh = q.shape
     q3, k3, v3 = (x.reshape(b * h, s, dh) for x in (q, k, v))
+    # Under shard_map (e.g. as Ulysses' per-device attention) the output
+    # must declare which mesh axes it varies over — inherit q's.
+    vma = getattr(jax.typeof(q3), "vma", frozenset())
     out = pl.pallas_call(
         partial(_flash_kernel, blk_k=blk_k, causal=causal, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, dh), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, dh), q.dtype, vma=vma),
         grid=(b * h, s // blk_q),
         in_specs=[
             pl.BlockSpec((1, blk_q, dh), lambda bh, iq: (bh, iq, 0), memory_space=pltpu.VMEM),

@@ -39,32 +39,46 @@ from jax.sharding import Mesh, PartitionSpec as P
 from dmlc_tpu.parallel.ring_attention import dense_attention
 
 
-def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float, local_attn=None):
     """Per-device body. q/k/v: [B, H, S/n, Dh] -> same shape/sharding.
 
     all_to_all(split_axis=1, concat_axis=2) turns the local sequence shard
     into the full sequence for H/n heads; attention is then embarrassingly
     parallel over heads, and the inverse all_to_all restores sequence
     sharding. Differentiable end-to-end (all_to_all transposes to itself
-    with the axes swapped).
+    with the axes swapped). ``local_attn`` swaps the per-device attention
+    (default dense; the Pallas flash kernel composes here for O(S) memory
+    on the reassembled sequence).
     """
+    attn = local_attn or dense_attention
     a2a = partial(lax.all_to_all, axis_name=axis_name, tiled=True)
     # [B, H, S/n, Dh] -> [B, H/n, S, Dh]: heads scatter, sequence gathers.
     qh, kh, vh = (a2a(t, split_axis=1, concat_axis=2) for t in (q, k, v))
-    out = dense_attention(qh, kh, vh, causal=causal, scale=scale)
+    out = attn(qh, kh, vh, causal=causal, scale=scale)
     # [B, H/n, S, Dh] -> [B, H, S/n, Dh].
     return a2a(out, split_axis=2, concat_axis=1)
 
 
 def ulysses_attention(
-    q, k, v, mesh: Mesh, *, axis_name: str = "sp", causal: bool = False, scale: float | None = None
+    q,
+    k,
+    v,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    causal: bool = False,
+    scale: float | None = None,
+    use_flash: bool = False,
 ):
     """Sequence-parallel attention via head/sequence all-to-all resharding.
 
     q/k/v: [B, H, S, Dh] with S sharded over ``axis_name`` in ``mesh``;
     returns [B, H, S, Dh] with the same sharding. Requires the head count to
     be divisible by the ``sp`` extent (checked eagerly — the failure inside
-    all_to_all is far less readable)."""
+    all_to_all is far less readable). ``use_flash`` runs the per-device
+    attention with the blockwise Pallas kernel (ops/pallas_kernels.py)
+    instead of dense — sp handles sequences past one chip, flash keeps the
+    reassembled full-sequence attention O(S) in memory."""
     n = mesh.shape[axis_name]
     if q.shape[1] % n:
         raise ValueError(
@@ -72,6 +86,19 @@ def ulysses_attention(
         )
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    local_attn = None
+    if use_flash:
+        from dmlc_tpu.ops.pallas_kernels import flash_attention
+
+        local_attn = flash_attention
     spec = P(None, None, axis_name, None)
-    fn = partial(_ulysses_local, axis_name=axis_name, causal=causal, scale=scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+    fn = partial(
+        _ulysses_local, axis_name=axis_name, causal=causal, scale=scale, local_attn=local_attn
+    )
+    # check_vma off for the flash variant: interpret-mode pallas_call's
+    # discharge mixes varying and unvarying operands inside dynamic_slice,
+    # which the vma checker rejects (jax suggests exactly this workaround);
+    # the dense variant keeps full checking.
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=not use_flash
+    )(q, k, v)

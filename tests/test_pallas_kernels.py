@@ -99,3 +99,21 @@ def test_flash_attention_rejects_indivisible_sequence():
     q, k, v = _qkv(4, s=192, d=16)  # 192 % 128 != 0
     with pytest.raises(ValueError, match="not divisible"):
         flash_attention(q, k, v)
+
+
+def test_flash_attention_grads_match_dense():
+    from dmlc_tpu.ops.pallas_kernels import flash_attention
+    from dmlc_tpu.parallel.ring_attention import dense_attention
+
+    q, k, v = _qkv(5, s=128, d=32)
+
+    def loss(att, q, k, v):
+        return jnp.sum(att(q, k, v, causal=True) ** 2)
+
+    want = jax.grad(lambda q, k, v: loss(dense_attention, q, k, v), argnums=(0, 1, 2))(q, k, v)
+    got = jax.grad(
+        lambda q, k, v: loss(lambda *a, **kw: flash_attention(*a, **kw), q, k, v),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=3e-5, rtol=1e-4)

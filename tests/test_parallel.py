@@ -309,3 +309,11 @@ class TestUlyssesAttention:
         q, k, v = self._qkv(5, h=4)  # 4 heads over sp=8: refused
         with pytest.raises(ValueError, match="heads % sp"):
             ulysses_attention(q, k, v, mesh)
+
+    def test_flash_local_attention_composes(self):
+        # sp reshard + per-device Pallas flash kernel = dense result.
+        mesh = make_mesh({"sp": 8})
+        q, k, v = self._qkv(6)
+        ref = dense_attention(q, k, v, causal=True)
+        got = ulysses_attention(q, k, v, mesh, causal=True, use_flash=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5, rtol=1e-4)
