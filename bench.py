@@ -7,7 +7,9 @@ inference img/s/chip):
 stderr: one line per benched config (resnet18, resnet50, vit_b16,
 clip_vit_l14 bf16 embedding) with p50/p99 batch latency and an MFU estimate,
 plus the end-to-end JPEG->top-1 pipeline numbers. Full detail also lands in
-bench_detail.json.
+bench_detail.json. The headline runs unconditionally; the extras respect a
+wall-clock --budget-s so the run exits cleanly under the driver's timeout
+even when the remote tunnel is slow.
 
 The reference's scheduler tops out at 2 qps/job (1 query / 0.5 s,
 src/services.rs:408,412) => 4 images/sec across the whole 10-VM cluster with
@@ -26,9 +28,8 @@ pipeline). The e2e section reports the JPEG->top-1 rate through
 host decode capacity on its own, so the host-pipeline bottleneck is
 measured instead of asserted. Caveat for reading e2e over the tunnel: the
 e2e columns ship full pixel batches through the network hop and measure
-ITS bandwidth (device-resize mode ships ~30% more bytes at RAW_SIZE and
-can read slower here despite costing the host 4x less CPU — decode_raw vs
-decode_only is the host-side signal that transfers to real hardware).
+ITS bandwidth; decode_raw vs decode_only is the host-side signal (the
+device-resize path's CPU win) that transfers to real hardware.
 """
 
 from __future__ import annotations
@@ -181,20 +182,18 @@ def bench_e2e(model: str, batch_size: int, corpus_root: str) -> dict:
         engine.run_paths(paths[s : s + batch_size])
     serial_s = time.perf_counter() - t0
 
-    # Device-resize variant: host decodes RAW (corpus-native, no host
-    # resample — ~35% of host CPU), chip resizes via MXU matmuls.
-    dr_engine = InferenceEngine(
-        model, batch_size=batch_size, use_pallas=False, device_resize_from=RAW_SIZE
-    )
-    dr_engine.warmup()
-    pp.load_batch(paths[:batch_size], size=dr_engine.input_size)
+    # Host decode at RAW size (no host resample): the host-side capacity of
+    # the device-resize path (ops/device_resize.py). Only the HOST number is
+    # measured here — running the device-resize engine end-to-end over the
+    # remote tunnel ships ~30% more bytes through the network hop and
+    # measures the tunnel, not the design (and its extra compile broke the
+    # whole-bench time budget); tests/test_device_resize.py pins the chip
+    # side, this pins the host-CPU win that transfers to real TPU-VMs.
+    pp.load_batch(paths[:batch_size], size=RAW_SIZE)
     t0 = time.perf_counter()
     for s in range(0, len(paths), batch_size):
-        pp.load_batch(paths[s : s + batch_size], size=dr_engine.input_size)
+        pp.load_batch(paths[s : s + batch_size], size=RAW_SIZE)
     decode_raw_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    dr_engine.run_paths_stream(paths)
-    e2e_dr_s = time.perf_counter() - t0
 
     n = len(paths)
     return {
@@ -203,7 +202,6 @@ def bench_e2e(model: str, batch_size: int, corpus_root: str) -> dict:
         "decode_only_img_s": round(n / decode_s, 1),
         "decode_raw_img_s": round(n / decode_raw_s, 1),
         "e2e_img_s": round(n / e2e_s, 1),
-        "e2e_device_resize_img_s": round(n / e2e_dr_s, 1),
         "serial_img_s": round(n / serial_s, 1),
         "overlap_speedup": round(serial_s / e2e_s, 2),
     }
@@ -226,7 +224,17 @@ def main() -> None:
     parser.add_argument("--e2e", action="store_true", default=True)
     parser.add_argument("--no-e2e", dest="e2e", action="store_false")
     parser.add_argument("--corpus", default="bench_corpus")
+    parser.add_argument(
+        "--budget-s",
+        type=float,
+        default=300.0,
+        help="wall-clock budget: a secondary config or the e2e section only "
+        "STARTS while under this, so with the slowest single item (~4 min "
+        "of compile+run on a degraded tunnel) the whole run still exits "
+        "cleanly inside a ~10 min driver timeout. The headline always runs.",
+    )
     args = parser.parse_args()
+    t_start = time.monotonic()
 
     # Per-model batch tuning: the headline ResNet-18 runs fastest at 1024
     # (measured 30.9k img/s MFU 0.53 @ 1024, vs 29.3k @ 512, 26k @ 256,
@@ -237,6 +245,8 @@ def main() -> None:
     if args.batch_size is not None and args.batch_size <= 0:
         parser.error("--batch-size must be positive")
     base_batch = args.batch_size if args.batch_size is not None else 256
+    # resnet50 measures ~11% faster at 512, but the extra compile time blew
+    # the whole-bench budget (observed timeout); secondaries stay at 256.
     batch_overrides = {"resnet18": 1024} if args.batch_size is None else {}
     models = [m.strip() for m in args.models.split(",") if m.strip()]
 
@@ -280,8 +290,21 @@ def main() -> None:
         flush=True,
     )
 
+    def over_budget(what: str) -> bool:
+        elapsed = time.monotonic() - t_start
+        if elapsed > args.budget_s:
+            print(
+                f"[bench] skipping {what}: {elapsed:.0f}s elapsed > "
+                f"--budget-s {args.budget_s:.0f}",
+                file=sys.stderr,
+            )
+            return True
+        return False
+
     results = [head]
     for model in remaining:
+        if over_budget(model):
+            continue
         try:
             r = bench_model(
                 model, batch_overrides.get(model, base_batch), seconds=2.5, passes=1
@@ -293,7 +316,7 @@ def main() -> None:
         stderr_line(r)
 
     e2e = None
-    if args.e2e:
+    if args.e2e and not over_budget("e2e"):
         try:
             e2e = bench_e2e(head["model"], base_batch, args.corpus)
             print(
@@ -301,7 +324,6 @@ def main() -> None:
                 f"decode_only={e2e['decode_only_img_s']} img/s "
                 f"decode_raw={e2e['decode_raw_img_s']} img/s "
                 f"e2e={e2e['e2e_img_s']} img/s "
-                f"e2e_device_resize={e2e['e2e_device_resize_img_s']} img/s "
                 f"serial={e2e['serial_img_s']} img/s "
                 f"overlap_speedup={e2e['overlap_speedup']}x",
                 file=sys.stderr,

@@ -95,10 +95,12 @@ def ulysses_attention(
     fn = partial(
         _ulysses_local, axis_name=axis_name, causal=causal, scale=scale, local_attn=local_attn
     )
-    # check_vma off for the flash variant: interpret-mode pallas_call's
-    # discharge mixes varying and unvarying operands inside dynamic_slice,
-    # which the vma checker rejects (jax suggests exactly this workaround);
-    # the dense variant keeps full checking.
+    # check_vma off ONLY for the flash variant in INTERPRET mode (off-TPU):
+    # interpret-mode pallas_call's discharge mixes varying and unvarying
+    # operands inside dynamic_slice, which the vma checker rejects (jax
+    # suggests exactly this workaround). Compiled TPU runs and the dense
+    # variant keep full checking.
+    check = not (use_flash and jax.default_backend() != "tpu")
     return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=not use_flash
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=check
     )(q, k, v)

@@ -111,9 +111,6 @@ def test_flash_attention_grads_match_dense():
         return jnp.sum(att(q, k, v, causal=True) ** 2)
 
     want = jax.grad(lambda q, k, v: loss(dense_attention, q, k, v), argnums=(0, 1, 2))(q, k, v)
-    got = jax.grad(
-        lambda q, k, v: loss(lambda *a, **kw: flash_attention(*a, **kw), q, k, v),
-        argnums=(0, 1, 2),
-    )(q, k, v)
+    got = jax.grad(lambda q, k, v: loss(flash_attention, q, k, v), argnums=(0, 1, 2))(q, k, v)
     for g, w in zip(got, want):
         np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=3e-5, rtol=1e-4)
