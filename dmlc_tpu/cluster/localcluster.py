@@ -29,6 +29,7 @@ def wait_until(cond, timeout: float = 30.0, interval: float = 0.02, msg: str = "
 
 def make_synsets(path: Path, n: int) -> Path:
     """A synset_words.txt with n synthetic classes (truth = line index)."""
+    path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text("".join(f"n{i:08d} label {i}\n" for i in range(n)))
     return path
 
@@ -58,24 +59,33 @@ def start_local_cluster(
 
     Returns the node list; caller owns shutdown (``stop_local_cluster``).
     """
-    base = random.randint(21000, 52000) // 10 * 10
-    candidates = [
-        f"127.0.0.1:{base + 10 * i + 1}" for i in range(n_leader_candidates)
-    ]
     overrides = dict(config_overrides)
     synset_path = overrides.pop("synset_path", None)
     if synset_path is None:
         synset_path = make_synsets(tmp / "synsets.txt", 40)
-    nodes = []
-    try:
-        return _start_all(tmp, n_nodes, base, candidates, synset_path, overrides,
-                          backends, scale, join, nodes)
-    except Exception:
-        # A half-started fleet (port collision, convergence timeout) must
-        # not leak bound ports and heartbeat threads into the caller, who
-        # never got a handle to stop them.
-        stop_local_cluster(nodes)
-        raise
+    last: Exception | None = None
+    for attempt in range(3):
+        base = random.randint(21000, 52000) // 10 * 10
+        candidates = [
+            f"127.0.0.1:{base + 10 * i + 1}" for i in range(n_leader_candidates)
+        ]
+        nodes: list = []
+        try:
+            return _start_all(tmp, n_nodes, base, candidates, synset_path, overrides,
+                              backends, scale, join, nodes)
+        except OSError as e:
+            # Random port block collided with another harness cluster (or a
+            # busy system port): clean up and redraw — observed as a rare
+            # cross-test flake before this retry existed.
+            stop_local_cluster(nodes)
+            last = e
+        except Exception:
+            # A half-started fleet (convergence timeout etc.) must not leak
+            # bound ports and heartbeat threads into the caller, who never
+            # got a handle to stop them.
+            stop_local_cluster(nodes)
+            raise
+    raise last
 
 
 def _start_all(tmp, n_nodes, base, candidates, synset_path, overrides,

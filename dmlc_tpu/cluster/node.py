@@ -79,10 +79,10 @@ class ClusterNode:
                 # sdfs is wired in below once the client exists (the member
                 # server needs the backends first); the backend is lazy, so
                 # nothing touches sdfs until warmup/first shard.
+                # No batch size here: the serving batch is the published
+                # artifact's, fixed at export time.
                 backends = {
-                    name: ExportedBackend(
-                        name, config.data_dir, sdfs=None, batch_size=config.batch_size
-                    )
+                    name: ExportedBackend(name, config.data_dir, sdfs=None)
                     for name in config.job_models
                 }
             else:

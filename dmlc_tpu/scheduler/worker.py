@@ -135,13 +135,11 @@ class ExportedBackend:
         model_name: str,
         data_dir: str | Path,
         sdfs,
-        batch_size: int = 256,
         image_source=None,
     ):
         self.model_name = model_name
         self.data_dir = Path(data_dir)
         self.sdfs = sdfs
-        self.batch_size = batch_size
         self.image_source = image_source
         self._server = None
         self._lock = threading.Lock()
@@ -162,6 +160,19 @@ class ExportedBackend:
 
             spec = get_model(self.model_name)
             version, exported = export_lib.fetch_executable(self.sdfs, self.model_name)
+            # The artifact's input shape is FIXED at export: serving batch
+            # and input size come from IT, never from node config — an
+            # artifact exported at another size must not shape-mismatch.
+            u8_avals = [
+                a for a in exported.in_avals if str(a.dtype) == "uint8" and len(a.shape) == 4
+            ]
+            if not u8_avals:
+                raise RpcError(
+                    f"executable for {self.model_name!r} has no uint8 NHWC "
+                    "input — not a serving artifact this backend can drive"
+                )
+            u8_aval = u8_avals[0]
+            artifact_batch = int(u8_aval.shape[0])
             try:
                 _, blob = self.sdfs.get_bytes(weights_lib.sdfs_weights_name(self.model_name))
                 # Validation errors (corrupt/mismatched blob) PROPAGATE —
@@ -177,9 +188,10 @@ class ExportedBackend:
                 variables = jax.tree_util.tree_map(np.asarray, variables)
                 log.info("%s: artifact v%d, weights not published yet — random init", self.model_name, version)
             self._server = export_lib.ExportedServer(
-                exported, variables, self.batch_size, classifier=spec.classifier
+                exported, variables, artifact_batch, classifier=spec.classifier
             )
-            self._input_size = spec.input_size
+            self._serve_batch = artifact_batch
+            self._input_size = int(u8_aval.shape[1])
         return self._server
 
     def __call__(self, synsets: Sequence[str]) -> list[int]:
@@ -187,16 +199,19 @@ class ExportedBackend:
 
         from dmlc_tpu.ops import preprocess as pp
 
+        if not synsets:
+            return []
         with self._lock:
             server = self._ensure_server()
+            chunk_size = self._serve_batch
             paths = _resolve_paths(self.image_source, self.data_dir, synsets)
-            starts = list(range(0, len(paths), self.batch_size))
+            starts = list(range(0, len(paths), chunk_size))
             preds: list[int] = []
             # Decode chunk i+1 while the artifact executes chunk i (the same
             # overlap EngineBackend gets from run_paths_stream).
             with concurrent.futures.ThreadPoolExecutor(max_workers=1) as decoder:
                 decode = lambda s: pp.load_batch(
-                    paths[s : s + self.batch_size], size=self._input_size
+                    paths[s : s + chunk_size], size=self._input_size
                 )
                 fut = decoder.submit(decode, starts[0])
                 for i, s in enumerate(starts):

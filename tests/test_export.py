@@ -115,7 +115,7 @@ def test_exported_backend_serves_shards_from_sdfs(tinynet_blob, tmp_path):
     weights_lib.publish_weights(client, "tinynet", variables)
 
     data_dir, _ = corpus.generate(tmp_path / "corpus", n_classes=3, images_per_class=1, size=32)
-    backend = ExportedBackend("tinynet", data_dir, client, batch_size=8)
+    backend = ExportedBackend("tinynet", data_dir, client)
     worker = PredictWorker({"tinynet": backend})
     reply = worker._predict(
         {"model": "tinynet", "synsets": ["n00000000", "n00000001", "n00000002"]}
@@ -128,3 +128,17 @@ def test_exported_backend_serves_shards_from_sdfs(tinynet_blob, tmp_path):
     backend.load_variables(variables)
     reply = worker._predict({"model": "tinynet", "synsets": ["n00000001"]})
     assert reply["predictions"] == [2]
+
+    # Multi-batch shard: the serving batch is the ARTIFACT's (fixed at
+    # export), so a shard larger than it chunks through the overlapped
+    # decode loop — publish a batch-2 artifact and send 6 queries.
+    client.put_bytes(
+        export_lib.export_serving("tinynet", batch_size=2),
+        export_lib.sdfs_executable_name("tinynet"),
+    )
+    small = ExportedBackend("tinynet", data_dir, client)
+    assert small([]) == []  # empty shard: no decode, no crash
+    synsets = ["n00000000", "n00000001", "n00000002"] * 2  # 6 queries, 3 chunks
+    preds = small(synsets)
+    assert small._serve_batch == 2  # the ARTIFACT's batch, not node config
+    assert preds == [5] * 6  # fresh backend serves the v2 artifact + v1 weights

@@ -53,6 +53,32 @@ def test_import_tool_loads_npz(tmp_path):
     assert set(sd) == {"a", "b"} and sd["a"].shape == (2, 2)
 
 
+def test_import_tool_publishes_to_live_cluster(tmp_path, capsys):
+    """--leader: the blob rides sdfs.put_inline over real TCP to the
+    elected leader, lands replicated, and is visible in the directory."""
+    from dmlc_tpu.cluster.localcluster import start_local_cluster, stop_local_cluster
+    from test_model_parity import TorchResNet18
+
+    torch.manual_seed(4)
+    ckpt = tmp_path / "resnet18.pth"
+    torch.save(TorchResNet18(num_classes=1000).state_dict(), ckpt)
+
+    nodes = start_local_cluster(tmp_path / "fleet", n_nodes=3)
+    try:
+        leader = nodes[0].self_leader_addr
+        tool = _load_tool()
+        rc = tool.main(["resnet18", str(ckpt), "--leader", leader])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "published v1" in out
+        listing = nodes[1].sdfs.ls("models/resnet18")
+        replicas = listing["models/resnet18"]
+        assert len(replicas) == 2  # harness rf
+        assert all(1 in vs for vs in replicas.values())
+    finally:
+        stop_local_cluster(nodes)
+
+
 def test_import_tool_requires_destination(tmp_path, capsys):
     tool = _load_tool()
     ckpt = tmp_path / "x.npz"
