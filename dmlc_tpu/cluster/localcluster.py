@@ -74,6 +74,13 @@ def start_local_cluster(
             return _start_all(tmp, n_nodes, base, candidates, synset_path, overrides,
                               backends, scale, join, nodes)
         except OSError as e:
+            import errno
+
+            if e.errno != errno.EADDRINUSE:
+                # Only genuine port collisions are worth a redraw; other OS
+                # failures (fd exhaustion, disk) would just repeat.
+                stop_local_cluster(nodes)
+                raise
             # Random port block collided with another harness cluster (or a
             # busy system port): clean up and redraw — observed as a rare
             # cross-test flake before this retry existed.

@@ -119,6 +119,71 @@ class TestIndirectProbes:
                 assert c.statuses_seen_by(viewer)[victim] == "failed"
 
 
+@pytest.mark.parametrize("seed", range(3))
+def test_leader_churn_chaos_exactly_once(seed):
+    """Repeated leader kill -> standby promote -> resume cycles, with random
+    progress between each: however many times leadership churns, every query
+    is counted exactly once and the final leader finishes the workload (the
+    reference's failover scenario, iterated instead of tried once)."""
+    from dmlc_tpu.scheduler.jobs import JobScheduler
+    from dmlc_tpu.cluster.failover import StandbyLeader
+
+    rng = random.Random(seed)
+    n_queries = 160
+    f = Fixture(n_members=6, n_queries=n_queries, shard=16)
+    candidates = [f"L{i}" for i in range(4)]  # distinct from the Fixture's "L"
+
+    # Build a chain of candidate schedulers, all serving on the same fabric.
+    def make_candidate(addr):
+        sched = JobScheduler(
+            f.net.client(addr),
+            lambda: list(f.live),
+            jobs={
+                "resnet18": [(f"n{i:05d}", i) for i in range(n_queries)],
+                "alexnet": [(f"n{i:05d}", i) for i in range(n_queries)],
+            },
+            shard_size=16,
+            timer=f._fake_timer(),
+        )
+        f.net.serve(addr, sched.methods())
+        monitor = StandbyLeader(f.net.client(addr), addr, candidates, sched)
+        return sched, monitor
+
+    chain = {addr: make_candidate(addr) for addr in candidates}
+    # First candidate claims leadership and starts the jobs.
+    chain[candidates[0]][1].step()
+    assert chain[candidates[0]][1].is_leader
+    chain[candidates[0]][0]._start({})
+
+    alive = list(candidates)
+    leader = candidates[0]
+    for _ in range(len(candidates) - 1):
+        sched = chain[leader][0]
+        sched.assign_once()
+        # Random amount of progress under the current leader.
+        for _ in range(rng.randrange(1, 6)):
+            sched.dispatch_all_once()
+        # Standbys sync from the live leader, then the leader dies.
+        for addr in alive:
+            if addr != leader:
+                chain[addr][1].step()
+        f.net.crash(leader)
+        alive.remove(leader)
+        # The next live candidate notices and promotes (auto-resume).
+        for addr in alive:
+            chain[addr][1].step()
+        new_leader = next(a for a in alive if chain[a][1].is_leader)
+        assert new_leader != leader
+        leader = new_leader
+
+    final = chain[leader][0]
+    final.assign_once()
+    final.run_to_completion()
+    for name, job in final.jobs.items():
+        assert job.finished == n_queries, f"{name}: {job.finished} (seed {seed})"
+        assert job.correct == n_queries, f"{name} lost/duplicated (seed {seed})"
+
+
 @pytest.mark.parametrize("seed", range(4))
 def test_scheduler_chaos_exactly_once(seed):
     rng = random.Random(seed)
