@@ -136,6 +136,7 @@ class InferenceEngine:
             }
             dp_size = self.mesh.devices.shape[axis]
             rows_owned = len(dp_coords) * (self.batch_size // dp_size)
+            coords = sorted(dp_coords)
             if rows_owned != self.batch_size // procs:
                 self._global_batch_error = (
                     f"mesh layout puts {rows_owned} batch rows on process {me} "
@@ -143,6 +144,17 @@ class InferenceEngine:
                     "(= batch/processes): the dp axis must partition rows by "
                     "process — lay dp over processes (slowest-varying mesh "
                     "axis), tp/sp within hosts"
+                )
+            elif coords != list(range(coords[0], coords[0] + len(coords))):
+                # Non-contiguous dp coords would make local_rows' sort-by-
+                # global-start disagree with the row order
+                # make_array_from_process_local_data packed the local batch
+                # in — results would come back silently permuted. Refuse.
+                self._global_batch_error = (
+                    f"process {me} owns non-contiguous dp coordinates {coords}: "
+                    "run_batch_global requires each process's dp slice to be "
+                    "one contiguous run so local row order matches global row "
+                    "order — build the mesh with an unpermuted device list"
                 )
         self._forward = jax.jit(forward, in_shardings=(param_shd, data_shd), out_shardings=out_shd)
 
@@ -171,9 +183,12 @@ class InferenceEngine:
         self.variables = mesh_lib.shard_params(self.mesh, variables)
 
     def warmup(self) -> float:
-        """Compile with a zero batch; returns compile+first-run seconds."""
+        """Compile with a zero batch; returns compile+first-run seconds.
+        The batch is a device-side constant (jnp, not np): a host zeros
+        array would ship batch_size full images over the host->device link
+        just to warm up — 150+ MB of nothing on a remote-tunnel TPU."""
         t0 = time.perf_counter()
-        u8 = np.zeros((self.batch_size, self.input_size, self.input_size, 3), np.uint8)
+        u8 = jnp.zeros((self.batch_size, self.input_size, self.input_size, 3), jnp.uint8)
         jax.block_until_ready(self._forward(self.variables, u8))
         return time.perf_counter() - t0
 

@@ -1,0 +1,30 @@
+"""Persistent XLA compilation cache — ONE switch shared by every entry
+point (bench.py, __graft_entry__.py, tests/conftest.py).
+
+The cache is keyed by platform+topology+HLO, so remote-TPU and virtual-CPU
+entries coexist in one directory; a warm process spends ~0 s compiling
+(probed on the axon tunnel: 2.3 s -> 0.02 s). ``DMLC_JAX_CACHE_DIR``
+overrides the location (default: ``<repo>/.jax_cache``, gitignored).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def enable(cache_dir: str | None = None) -> None:
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        cache_dir
+        or os.environ.get("DMLC_JAX_CACHE_DIR", str(_REPO_ROOT / ".jax_cache")),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+    # Persist XLA's internal (autotuning etc.) caches too, not just final
+    # executables — without these a "warm" hit still re-runs part of the
+    # compile pipeline.
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "all")

@@ -28,6 +28,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache (keyed by platform+HLO, shared with bench.py's
+# TPU entries without collision): the suite's dominant cost is XLA compiles,
+# and a warm cache cuts reruns from minutes to seconds.
+from dmlc_tpu.utils import compile_cache  # noqa: E402
+
+compile_cache.enable()
+
 # Build the native data-plane library once (best effort) so its tests run
 # against the real .so; the library is a gitignored build artifact.
 try:
