@@ -91,15 +91,38 @@ def _softmax_top1_kernel(logits_ref, idx_ref, prob_ref):
 
 
 # ---------------------------------------------------------------------------
-# flash attention (the hot op of the transformer families)
+# flash attention (the hot op of the transformer families) — training-grade:
+# O(S)-memory forward AND backward, with the [S, S] score matrix never
+# materialized in either direction.
 # ---------------------------------------------------------------------------
 
+# K/V bytes per (batch, head) above which the forward streams K/V blocks
+# from HBM instead of holding them VMEM-resident. Resident is faster (K/V
+# read once per batch-head instead of once per q block) and is used
+# whenever it fits; 4 MiB leaves room for q/o blocks, the f32 score block,
+# and Mosaic's double buffering in ~16 MiB of VMEM (bf16 Dh=128: S=8192
+# resident — matching the measured compile ceiling — S=16384+ streamed).
+_RESIDENT_KV_BYTES = 4 * 1024 * 1024
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_k: int, causal: bool, scale: float):
-    """One (batch*head, q-block) cell: online-softmax over k blocks.
 
-    q_ref: [1, blk_q, Dh]; k_ref/v_ref: [1, S, Dh] (VMEM-resident K/V — see
-    flash_attention's docstring for the capacity trade-off); o_ref like q.
+def _auto_block(s: int, requested: int | None, default: int) -> int:
+    """Largest divisor of ``s`` not exceeding the requested block size —
+    S=192 with 128-blocks runs at blk=64 instead of failing."""
+    blk = min(requested if requested is not None else default, s)
+    for d in range(blk, 0, -1):
+        if s % d == 0:
+            return d
+    return 1
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, *, blk_k: int, causal: bool, scale: float
+):
+    """Resident-K/V forward: one (batch*head, q-block) cell, online-softmax
+    over k blocks sliced from VMEM.
+
+    q_ref: [1, blk_q, Dh]; k_ref/v_ref: [1, S, Dh] (VMEM-resident K/V);
+    o_ref like q; lse_ref: [1, blk_q] log-sum-exp, the backward's residual.
     The [blk_q, S] score matrix is never materialized: each k block's scores
     live only for one loop step, folded into the running (m, l, acc).
     """
@@ -144,40 +167,191 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_k: int, causal: bool, scale
         n_loop = jnp.minimum(n_k, ((iq + 1) * blk_q + blk_k - 1) // blk_k)
     else:
         n_loop = n_k
-    _, l, acc = jax.lax.fori_loop(0, n_loop, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    m, l, acc = jax.lax.fori_loop(0, n_loop, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)  # [blk_q, 1] — lse is carried [bh, S, 1]
+
+
+def _flash_fwd_stream_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, causal: bool, scale: float,
+):
+    """Streamed-K/V forward: grid (bh, q-block, k-block), K/V blocks fetched
+    from HBM per cell, online-softmax state carried across the (sequential)
+    k dimension in VMEM scratch. Lifts the resident path's S cap: working
+    set is O(blk_q * blk_k) regardless of S."""
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    n_k = pl.num_programs(2)
+    blk_q, blk_k = q_ref.shape[1], k_ref.shape[1]
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        s = jax.lax.dot_general(
+            q, k_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            q_pos = iq * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            k_pos = ik * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
+        m = m_scr[:]
+        m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
+        corr = jnp.where(jnp.isneginf(m_new), 1.0, jnp.exp(m - m_new))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(jnp.isneginf(s), 0.0, p)
+        l_scr[:] = l_scr[:] * corr + p.sum(axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new
+
+    if causal:
+        # Blocks wholly past the causal frontier contribute nothing: their
+        # compute is predicated off (the block fetch still happens — the
+        # grid is static — but the MXU work, the 2x term, is skipped).
+        pl.when(ik * blk_k < (iq + 1) * blk_q)(compute)
+    else:
+        compute()
+
+    @pl.when(ik == n_k - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(l_safe)
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, causal: bool, scale: float,
+):
+    """dQ: grid (bh, q-block, k-block); for each q block, accumulate
+    dq = scale * sum_k ds @ K over streamed k blocks (FlashAttention-2
+    form: p recomputed from the forward's lse, no [S, S] buffer)."""
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    n_k = pl.num_programs(2)
+    blk_q, blk_k = q_ref.shape[1], k_ref.shape[1]
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    def compute():
+        qs = q_ref[0].astype(jnp.float32) * scale
+        kb = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qs, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )                                                       # [blk_q, blk_k]
+        if causal:
+            q_pos = iq * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            k_pos = ik * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
+        p = jnp.exp(s - lse_ref[0])                             # lse: [blk_q, 1]
+        if causal:
+            # A fully-masked row has lse == -inf; exp(-inf - -inf) is nan.
+            p = jnp.where(jnp.isneginf(s), 0.0, p)
+        do = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                       # [blk_q, blk_k]
+        ds = p * (dp - delta_ref[0])
+        dq_scr[:] += jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        pl.when(ik * blk_k < (iq + 1) * blk_q)(compute)
+    else:
+        compute()
+
+    @pl.when(ik == n_k - 1)
+    def _finalize():
+        dq_ref[0] = (dq_scr[:] * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr, *, causal: bool, scale: float,
+):
+    """dK/dV: grid (bh, k-block, q-block); for each k block, accumulate
+    dv = sum_q P^T @ dO and dk = sum_q dS^T @ (scale * Q) over streamed
+    q blocks."""
+    ik, iq = pl.program_id(1), pl.program_id(2)
+    n_q = pl.num_programs(2)
+    blk_q, blk_k = q_ref.shape[1], k_ref.shape[1]
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    def compute():
+        qs = q_ref[0].astype(jnp.float32) * scale
+        kb = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qs, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )                                                       # [blk_q, blk_k]
+        if causal:
+            q_pos = iq * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            k_pos = ik * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
+        p = jnp.exp(s - lse_ref[0])
+        if causal:
+            p = jnp.where(jnp.isneginf(s), 0.0, p)
+        do = do_ref[0].astype(jnp.float32)
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )                                                       # [blk_k, Dh]
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0])
+        dk_scr[:] += jax.lax.dot_general(
+            ds, qs, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )                                                       # [blk_k, Dh]
+
+    if causal:
+        # A k block only receives gradient from q blocks at or past it.
+        pl.when((iq + 1) * blk_q > ik * blk_k)(compute)
+    else:
+        compute()
+
+    @pl.when(iq == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
 def _flash(causal, scale, blk_q, blk_k, q, k, v):
-    return _flash_forward(causal, scale, blk_q, blk_k, q, k, v)
+    return _flash_forward(causal, scale, blk_q, blk_k, q, k, v)[0]
 
 
 def _flash_vjp_fwd(causal, scale, blk_q, blk_k, q, k, v):
-    return _flash_forward(causal, scale, blk_q, blk_k, q, k, v), (q, k, v)
+    out, lse = _flash_forward(causal, scale, blk_q, blk_k, q, k, v)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, scale, blk_q, blk_k, res, g):
-    """Backward = exact gradients by recomputing through the DENSE path
-    (one [S, S] scratch per batch-head in the backward only): the kernel's
-    O(S) memory win applies to inference and the forward pass; a blockwise
-    backward kernel is the remaining step if training at S near the memory
-    ceiling — at which point ring attention (fully differentiable, O(S/n))
-    is the supported route."""
-    from dmlc_tpu.parallel.ring_attention import dense_attention
-
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: dense_attention(q, k, v, causal=causal, scale=scale), q, k, v
-    )
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(causal, scale, q, k, v, out, lse, g)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = False, scale: float | None = None,
-                    blk_q: int = 128, blk_k: int = 128):
+                    blk_q: int | None = None, blk_k: int | None = None):
     """Blockwise (flash) attention: [B, H, S, Dh] q/k/v -> [B, H, S, Dh].
 
     Never materializes the [S, S] score matrix — per q block the working set
@@ -185,31 +359,41 @@ def flash_attention(q, k, v, *, causal: bool = False, scale: float | None = None
     memory scales with S, not S^2 (the enabler for long single-device
     sequences; combine with ring/Ulysses SP for sequences past one chip).
     Measured on v5e vs XLA's dense attention (bf16, Dh=128, causal):
-    13% faster at S=2048, 27% at S=8192.
+    parity at S=2048, 1.1-1.5x faster at S=8192 (artifact:
+    bench_detail.json["flash"], re-measured every bench run).
 
-    Simplification vs the maximal kernel: K/V for one (batch, head) stay
-    VMEM-resident ([S, Dh] each), so the k-loop slices VMEM instead of
-    streaming HBM — which caps S at VMEM capacity (bf16 Dh=128: S=8192
-    compiles, S=16384 overflows; measured). Past that cap, shard the
-    sequence with ring attention (parallel/ring_attention.py), whose
-    per-device block then fits this kernel again. Interpreter mode off-TPU
-    keeps tests hermetic.
+    Two forward schedules, chosen by K/V footprint (_RESIDENT_KV_BYTES):
+    VMEM-resident K/V while it fits (K/V read from HBM once per batch-head),
+    HBM-streamed K/V blocks past that (unbounded S — the old hard S=8192
+    compile ceiling is gone; bigger default q blocks keep the streamed
+    matmuls MXU-bound).
 
-    Requires S divisible by the block sizes (shrunk automatically for short
-    sequences); pad the sequence or pick divisible blocks otherwise.
+    Block sizes default per schedule and are shrunk to the largest divisor
+    of S, so any S with a factor >= 8 runs; genuinely pathological lengths
+    (e.g. prime S) are rejected rather than silently degraded to tiny
+    blocks — pad the sequence instead.
 
-    Differentiable: the backward recomputes exact gradients through the
-    dense path (see _flash_vjp_bwd for the memory trade-off), so the kernel
-    drops into trainable models (SPSelfAttention schedule="flash").
+    Differentiable with O(S) memory end-to-end: the forward saves only the
+    per-row log-sum-exp, and the backward recomputes p blockwise in two
+    kernels (dQ over streamed K, dK/dV over streamed Q — the
+    FlashAttention-2 schedule), so schedule="flash" is training-grade at
+    sequence lengths where the dense [S, S] recompute could never fit.
+    Interpreter mode off-TPU keeps tests hermetic.
     """
     s, dh = q.shape[2], q.shape[3]
-    blk_q = min(blk_q, s)
-    blk_k = min(blk_k, s)
-    if s % blk_q or s % blk_k:
-        raise ValueError(f"sequence {s} not divisible by blocks ({blk_q}, {blk_k})")
+    resident = 2 * s * dh * q.dtype.itemsize <= _RESIDENT_KV_BYTES
+    # Streamed cells refetch K/V per q block: blk_q sets the flops fetched
+    # per byte, and 256 keeps the MXU (not HBM) the bottleneck.
+    bq = _auto_block(s, blk_q, 128 if resident else 256)
+    bk = _auto_block(s, blk_k, 128 if resident else 256)
+    if min(bq, bk) < 8 and s > 8:
+        raise ValueError(
+            f"sequence {s} has no usable block divisor (largest <= requested is "
+            f"{min(bq, bk)}): pad the sequence or pass explicit blk_q/blk_k"
+        )
     if scale is None:
         scale = dh**-0.5
-    return _flash(causal, float(scale), blk_q, blk_k, q, k, v)
+    return _flash(causal, float(scale), bq, bk, q, k, v)
 
 
 def _flash_forward(causal, scale, blk_q, blk_k, q, k, v):
@@ -218,21 +402,107 @@ def _flash_forward(causal, scale, blk_q, blk_k, q, k, v):
     # Under shard_map (e.g. as Ulysses' per-device attention) the output
     # must declare which mesh axes it varies over — inherit q's.
     vma = getattr(jax.typeof(q3), "vma", frozenset())
-    out = pl.pallas_call(
-        partial(_flash_kernel, blk_k=blk_k, causal=causal, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, dh), q.dtype, vma=vma),
-        grid=(b * h, s // blk_q),
-        in_specs=[
-            pl.BlockSpec((1, blk_q, dh), lambda bh, iq: (bh, iq, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, s, dh), lambda bh, iq: (bh, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, s, dh), lambda bh, iq: (bh, 0, 0), memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, blk_q, dh), lambda bh, iq: (bh, iq, 0), memory_space=pltpu.VMEM
-        ),
+    # lse rides as [bh, S, 1]: the trailing singleton keeps the Mosaic
+    # block-shape rule happy ((1, blk_q, 1) has its last dim equal to the
+    # array's) AND gives kernels the [blk_q, 1] column layout directly.
+    out_shapes = (
+        jax.ShapeDtypeStruct((b * h, s, dh), q.dtype, vma=vma),
+        jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32, vma=vma),  # lse
+    )
+    resident = 2 * s * dh * q.dtype.itemsize <= _RESIDENT_KV_BYTES
+    if resident:
+        out, lse = pl.pallas_call(
+            partial(_flash_kernel, blk_k=blk_k, causal=causal, scale=scale),
+            out_shape=out_shapes,
+            grid=(b * h, s // blk_q),
+            in_specs=[
+                pl.BlockSpec((1, blk_q, dh), lambda bh, iq: (bh, iq, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, s, dh), lambda bh, iq: (bh, 0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, s, dh), lambda bh, iq: (bh, 0, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, blk_q, dh), lambda bh, iq: (bh, iq, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, blk_q, 1), lambda bh, iq: (bh, iq, 0), memory_space=pltpu.VMEM),
+            ),
+            interpret=_interpret(),
+        )(q3, k3, v3)
+    else:
+        out, lse = pl.pallas_call(
+            partial(_flash_fwd_stream_kernel, causal=causal, scale=scale),
+            out_shape=out_shapes,
+            grid=(b * h, s // blk_q, s // blk_k),
+            in_specs=[
+                pl.BlockSpec((1, blk_q, dh), lambda bh, iq, ik: (bh, iq, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, blk_k, dh), lambda bh, iq, ik: (bh, ik, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, blk_k, dh), lambda bh, iq, ik: (bh, ik, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, blk_q, dh), lambda bh, iq, ik: (bh, iq, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, blk_q, 1), lambda bh, iq, ik: (bh, iq, 0), memory_space=pltpu.VMEM),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((blk_q, 1), jnp.float32),
+                pltpu.VMEM((blk_q, 1), jnp.float32),
+                pltpu.VMEM((blk_q, dh), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(q3, k3, v3)
+    return out.reshape(b, h, s, dh), lse
+
+
+def _flash_backward(causal, scale, q, k, v, out, lse, do):
+    """Blockwise gradients (FlashAttention-2): one pass for dQ, one for
+    dK/dV, both streaming the non-resident operand — peak memory O(S)."""
+    b, h, s, dh = q.shape
+    bh = b * h
+    q3, k3, v3, do3 = (x.reshape(bh, s, dh) for x in (q, k, v, do))
+    o3 = out.reshape(bh, s, dh)
+    # delta_i = dO_i . O_i, the softmax-jacobian row term; O(S) and fused
+    # into the surrounding jit by XLA. [bh, S, 1] like lse.
+    delta = jnp.sum(
+        o3.astype(jnp.float32) * do3.astype(jnp.float32), axis=-1, keepdims=True
+    )
+    # Backward cells do ~3 matmuls per fetched block (vs the forward's 2),
+    # so 256 blocks keep both kernels MXU-bound; shrink for short S.
+    blk_q = _auto_block(s, None, 256)
+    blk_k = _auto_block(s, None, 256)
+    vma = getattr(jax.typeof(q3), "vma", frozenset())
+
+    qspec = pl.BlockSpec((1, blk_q, dh), lambda bh, iq, ik: (bh, iq, 0), memory_space=pltpu.VMEM)
+    kspec = pl.BlockSpec((1, blk_k, dh), lambda bh, iq, ik: (bh, ik, 0), memory_space=pltpu.VMEM)
+    rowspec = pl.BlockSpec((1, blk_q, 1), lambda bh, iq, ik: (bh, iq, 0), memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        partial(_flash_bwd_dq_kernel, causal=causal, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((bh, s, dh), q.dtype, vma=vma),
+        grid=(bh, s // blk_q, s // blk_k),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        scratch_shapes=[pltpu.VMEM((blk_q, dh), jnp.float32)],
         interpret=_interpret(),
-    )(q3, k3, v3)
-    return out.reshape(b, h, s, dh)
+    )(q3, k3, v3, do3, lse, delta)
+
+    # dK/dV grid: (bh, k-block, q-block) — q innermost so the scratch
+    # accumulators belong to one k block at a time.
+    qspec2 = pl.BlockSpec((1, blk_q, dh), lambda bh, ik, iq: (bh, iq, 0), memory_space=pltpu.VMEM)
+    kspec2 = pl.BlockSpec((1, blk_k, dh), lambda bh, ik, iq: (bh, ik, 0), memory_space=pltpu.VMEM)
+    rowspec2 = pl.BlockSpec((1, blk_q, 1), lambda bh, ik, iq: (bh, iq, 0), memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        partial(_flash_bwd_dkv_kernel, causal=causal, scale=scale),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, s, dh), k.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, s, dh), v.dtype, vma=vma),
+        ),
+        grid=(bh, s // blk_k, s // blk_q),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        out_specs=(kspec2, kspec2),
+        scratch_shapes=[
+            pltpu.VMEM((blk_k, dh), jnp.float32),
+            pltpu.VMEM((blk_k, dh), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse, delta)
+    shape = (b, h, s, dh)
+    return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
 
 
 @jax.jit

@@ -91,14 +91,59 @@ def test_flash_attention_short_sequence_shrinks_blocks():
     np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)
 
 
-def test_flash_attention_rejects_indivisible_sequence():
+def test_flash_attention_divisor_blocks():
+    # 192 % 128 != 0, but 64 divides it: blocks shrink to the largest
+    # divisor instead of rejecting (advisor finding, round 2).
+    from dmlc_tpu.ops.pallas_kernels import flash_attention
+    from dmlc_tpu.parallel.ring_attention import dense_attention
+
+    q, k, v = _qkv(4, s=192, d=16)
+    want = np.asarray(dense_attention(q, k, v, causal=True))
+    got = np.asarray(flash_attention(q, k, v, causal=True))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)
+
+
+def test_flash_attention_rejects_pathological_sequence():
     import pytest
 
     from dmlc_tpu.ops.pallas_kernels import flash_attention
 
-    q, k, v = _qkv(4, s=192, d=16)  # 192 % 128 != 0
-    with pytest.raises(ValueError, match="not divisible"):
+    q, k, v = _qkv(4, s=193, d=16)  # prime: largest usable divisor is 1
+    with pytest.raises(ValueError, match="block divisor"):
         flash_attention(q, k, v)
+
+
+def test_flash_attention_streamed_forward_matches_dense(monkeypatch):
+    # Force the HBM-streamed schedule (normally S past the VMEM cap) at a
+    # test-sized S by shrinking the resident threshold.
+    from dmlc_tpu.ops import pallas_kernels as pk
+    from dmlc_tpu.parallel.ring_attention import dense_attention
+
+    monkeypatch.setattr(pk, "_RESIDENT_KV_BYTES", 1)
+    q, k, v = _qkv(6, s=256, d=32)
+    for causal in (False, True):
+        want = np.asarray(dense_attention(q, k, v, causal=causal))
+        got = np.asarray(pk.flash_attention(q, k, v, causal=causal))
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)
+
+
+def test_flash_attention_streamed_grads_match_dense(monkeypatch):
+    # Blockwise backward over a streamed forward: several q AND k blocks in
+    # every kernel (the scratch-carry paths), both causal and not.
+    from dmlc_tpu.ops import pallas_kernels as pk
+    from dmlc_tpu.parallel.ring_attention import dense_attention
+
+    monkeypatch.setattr(pk, "_RESIDENT_KV_BYTES", 1)
+    q, k, v = _qkv(7, b=1, h=2, s=512, d=16)
+
+    for causal in (False, True):
+        def loss(att, q, k, v):
+            return jnp.sum(att(q, k, v, causal=causal) ** 2)
+
+        want = jax.grad(lambda q, k, v: loss(dense_attention, q, k, v), argnums=(0, 1, 2))(q, k, v)
+        got = jax.grad(lambda q, k, v: loss(pk.flash_attention, q, k, v), argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=5e-5, rtol=1e-4)
 
 
 def test_flash_attention_grads_match_dense():
