@@ -1,0 +1,61 @@
+"""Shared-key frame authentication for the control plane.
+
+The reference's control plane was only as safe as its network: any process
+that could reach a tarpc port could call Leader/Member services directly
+(src/main.rs:43-83) — it leaned on the fleet's ssh trust boundary
+(src/services.rs:244-272) rather than authenticating traffic. Here both
+fabrics (msgpack-TCP RPC and UDP gossip) carry an HMAC-SHA256 tag over every
+frame when ``ClusterConfig.auth_key`` is set: unauthenticated or tampered
+frames are dropped before any payload parsing, so reaching a port no longer
+grants ``sdfs.delete`` / ``job.start``.
+
+Design notes:
+- The tag is truncated to 16 bytes (standard HMAC truncation; 128-bit
+  forgery resistance) to keep gossip datagrams small.
+- Authentication, not encryption: payloads are readable on the wire, they
+  just cannot be forged or altered. Matches the threat ("any host can write
+  to the control plane"), not a full TLS story.
+- No replay protection: a recorded `sdfs.delete` frame could be replayed
+  while the key is unchanged. The reference had no protection at all; nonce
+  windows are a deliberate non-goal at this layer.
+"""
+
+from __future__ import annotations
+
+import hmac
+import hashlib
+
+
+TAG_BYTES = 16
+
+
+class AuthError(Exception):
+    """Frame failed authentication (missing, truncated, or wrong tag)."""
+
+
+class FrameAuth:
+    """Seals/opens byte frames with a truncated HMAC-SHA256 tag."""
+
+    def __init__(self, key: str | bytes):
+        if not key:
+            raise ValueError("FrameAuth requires a non-empty key")
+        self._key = key.encode() if isinstance(key, str) else bytes(key)
+
+    def _tag(self, data: bytes) -> bytes:
+        return hmac.new(self._key, data, hashlib.sha256).digest()[:TAG_BYTES]
+
+    def seal(self, data: bytes) -> bytes:
+        return self._tag(data) + data
+
+    def open(self, frame: bytes) -> bytes:
+        if len(frame) < TAG_BYTES:
+            raise AuthError(f"frame of {len(frame)} bytes is shorter than the tag")
+        tag, data = frame[:TAG_BYTES], frame[TAG_BYTES:]
+        if not hmac.compare_digest(tag, self._tag(data)):
+            raise AuthError("bad frame tag")
+        return data
+
+
+def maybe_auth(key: str | bytes | None) -> FrameAuth | None:
+    """Config plumbing: '' / None mean authentication disabled."""
+    return FrameAuth(key) if key else None

@@ -26,6 +26,14 @@ from dmlc_tpu.cluster.rpc import Rpc, RpcError, RpcUnreachable
 log = logging.getLogger(__name__)
 
 
+def epoch_key(epoch) -> tuple[int, str]:
+    """Total order over leadership epochs. An epoch is [counter, claimant]:
+    counters order successive terms; the claimant address breaks the tie
+    when two partitioned candidates claim the same counter — deterministic,
+    so every member and every candidate agrees on which term is newer."""
+    return int(epoch[0]), str(epoch[1])
+
+
 class LeaderTracker:
     """Which candidate do I currently believe is leader? Probe and advance."""
 
@@ -74,7 +82,14 @@ class StandbyLeader:
 
     Like the reference's static-candidate scheme, this is liveness-based,
     not a consensus protocol: a full network partition between candidates
-    can still yield two claimants until the partition heals.
+    can still yield two claimants until the partition heals. Leadership
+    EPOCHS fence the damage: every promotion takes a term strictly newer
+    than any term it has observed ([counter+1, self]), members reject SDFS
+    writes from older terms (SdfsMember fencing), and on heal the claimant
+    with the older term sees the newer one and abdicates — so a write acked
+    by a stale claimant is (a) almost always impossible (its placements are
+    rejected) and (b) never silently replaced under the same version by the
+    winning term's directory without having been refused first.
     """
 
     def __init__(
@@ -95,9 +110,17 @@ class StandbyLeader:
         self.mesh_bootstrap = mesh_bootstrap
         self.on_promote = on_promote
         self.is_leader = False
+        # Highest leadership epoch observed anywhere (my own while leading):
+        # promotions take [observed_counter + 1, self_addr].
+        self.seen_epoch: list = [0, ""]
+
+    def _observe_epoch(self, epoch) -> None:
+        if epoch is not None and epoch_key(epoch) > epoch_key(self.seen_epoch):
+            self.seen_epoch = [int(epoch[0]), str(epoch[1])]
 
     def step(self) -> None:
         if self.is_leader:
+            self._leading_step()
             return
         leading = None
         alive: set[str] = set()
@@ -109,6 +132,7 @@ class StandbyLeader:
             except (RpcUnreachable, RpcError):
                 continue
             alive.add(addr)
+            self._observe_epoch(status.get("epoch"))
             if status.get("leading"):
                 leading = addr
                 break
@@ -123,11 +147,50 @@ class StandbyLeader:
             if addr in alive:
                 return  # a live candidate ahead of us will promote
 
+    def _leading_step(self) -> None:
+        """While leading, watch for a claimant with a NEWER term (the healed
+        half of a candidate partition): the older term must abdicate, not
+        co-lead. Same-or-older claimants are ignored — they will see us and
+        abdicate themselves."""
+        for addr in self.candidates:
+            if addr == self.self_addr:
+                continue
+            try:
+                status = self.rpc.call(addr, "leader.status", {}, timeout=2.0)
+            except (RpcUnreachable, RpcError):
+                continue
+            other = status.get("epoch")
+            if (
+                status.get("leading")
+                and other is not None
+                and epoch_key(other) > epoch_key(self.seen_epoch)
+            ):
+                self._abdicate(addr, other)
+                return
+
+    def _abdicate(self, winner: str, winner_epoch) -> None:
+        log.warning(
+            "%s: abdicating epoch %s to %s (epoch %s)",
+            self.self_addr, self.seen_epoch, winner, winner_epoch,
+        )
+        self._observe_epoch(winner_epoch)
+        self.is_leader = False
+        self.scheduler.is_leading = False
+        if self.sdfs_leader is not None:
+            self.sdfs_leader.is_leading = False
+        if self.mesh_bootstrap is not None:
+            self.mesh_bootstrap.is_leading = False
+        # Drop in-flight work and mirror the winner — identical to a fresh
+        # standby joining.
+        self._sync_from(winner)
+
     def _sync_from(self, addr: str) -> None:
         try:
-            self.scheduler.adopt_state(self.rpc.call(addr, "job.state", {}, timeout=2.0))
+            state = self.rpc.call(addr, "job.state", {}, timeout=2.0)
+            self.scheduler.adopt_state(state)
             if self.sdfs_leader is not None:
                 wire = self.rpc.call(addr, "sdfs.state", {}, timeout=2.0)
+                self._observe_epoch(wire.get("epoch"))
                 self.sdfs_leader.adopt_state(wire)
             if self.mesh_bootstrap is not None:
                 wire = self.rpc.call(addr, "mesh.state", {}, timeout=2.0)
@@ -137,12 +200,23 @@ class StandbyLeader:
 
     def _promote(self) -> None:
         self.is_leader = True
+        self.seen_epoch = [int(self.seen_epoch[0]) + 1, self.self_addr]
         self.scheduler.is_leading = True
+        self.scheduler.epoch = list(self.seen_epoch)
         if self.sdfs_leader is not None:
             self.sdfs_leader.is_leading = True
+            self.sdfs_leader.epoch = list(self.seen_epoch)
+            # Best-effort fence announcement: members learn the new term
+            # BEFORE it accepts writes, so a stale claimant's placements
+            # bounce instead of landing (reachable members only — the fence
+            # still tightens as writes carry the epoch). Then rebuild
+            # reservations from member inventories, so versions acked by the
+            # old term but never mirrored here are not re-issued.
+            self.sdfs_leader.fence_members()
+            self.sdfs_leader.reconcile_from_members()
         if self.mesh_bootstrap is not None:
             self.mesh_bootstrap.is_leading = True
-        log.warning("%s: promoting to leader", self.self_addr)
+        log.warning("%s: promoting to leader (epoch %s)", self.self_addr, self.seen_epoch)
         if self.scheduler.has_history():
             # Resume interrupted jobs from the replicated cursor.
             self.scheduler._start({})

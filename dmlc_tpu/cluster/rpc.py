@@ -28,6 +28,8 @@ from typing import Callable
 
 import msgpack
 
+from dmlc_tpu.cluster.auth import AuthError, FrameAuth
+
 log = logging.getLogger(__name__)
 
 Method = Callable[[dict], dict]
@@ -123,19 +125,24 @@ _HDR = struct.Struct("!I")  # 4-byte big-endian frame length
 MAX_FRAME = 1 << 30  # 1 GiB — model weights fit; corrupt headers don't OOM us
 
 
-def _send_frame(sock: socket.socket, obj: dict) -> None:
+def _send_frame(sock: socket.socket, obj: dict, auth: FrameAuth | None = None) -> None:
     data = msgpack.packb(obj, use_bin_type=True)
+    if auth is not None:
+        data = auth.seal(data)
     if len(data) > MAX_FRAME:
         raise RpcError(f"frame of {len(data)} bytes exceeds MAX_FRAME")
     sock.sendall(_HDR.pack(len(data)) + data)
 
 
-def _recv_frame(sock: socket.socket) -> dict:
+def _recv_frame(sock: socket.socket, auth: FrameAuth | None = None) -> dict:
     hdr = _recv_exact(sock, _HDR.size)
     (length,) = _HDR.unpack(hdr)
     if length > MAX_FRAME:
         raise RpcUnreachable(f"frame header claims {length} bytes (> MAX_FRAME)")
-    return msgpack.unpackb(bytes(_recv_exact(sock, length)), raw=False)
+    data = bytes(_recv_exact(sock, length))
+    if auth is not None:
+        data = auth.open(data)  # AuthError -> caller drops the connection
+    return msgpack.unpackb(data, raw=False)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytearray:
@@ -153,8 +160,15 @@ def _recv_exact(sock: socket.socket, n: int) -> bytearray:
 class TcpRpcServer:
     """Threaded TCP server hosting one method table."""
 
-    def __init__(self, host: str, port: int, methods: dict[str, Method]):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        methods: dict[str, Method],
+        auth: FrameAuth | None = None,
+    ):
         self.methods = methods
+        self.auth = auth
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind((host, port))
@@ -179,14 +193,21 @@ class TcpRpcServer:
         with conn:
             try:
                 while True:
-                    req = _recv_frame(conn)
+                    req = _recv_frame(conn, self.auth)
                     try:
                         reply = _dispatch(self.methods, req["m"], req["p"])
-                        _send_frame(conn, {"ok": True, "r": reply})
+                        _send_frame(conn, {"ok": True, "r": reply}, self.auth)
                     except Exception as e:  # method error -> remote RpcError
-                        _send_frame(conn, {"ok": False, "e": f"{type(e).__name__}: {e}"})
+                        _send_frame(
+                            conn, {"ok": False, "e": f"{type(e).__name__}: {e}"}, self.auth
+                        )
             except (RpcUnreachable, OSError):
                 return  # client went away
+            except AuthError:
+                # Unauthenticated frame: drop the connection WITHOUT an error
+                # reply — an unkeyed caller gets silence, not an oracle.
+                log.warning("closing connection after unauthenticated frame")
+                return
             except Exception:
                 # Malformed frame (bad msgpack, missing keys): drop the
                 # connection, never the server.
@@ -204,15 +225,22 @@ class TcpRpc(Rpc):
     (heartbeats ride UDP, tensor bytes ride ICI/PCIe), so connection reuse
     is not worth the failure-mode complexity here."""
 
+    def __init__(self, auth: FrameAuth | None = None):
+        self.auth = auth
+
     def call(self, addr: str, method: str, payload: dict, timeout: float = 60.0) -> dict:
         host, _, port = addr.rpartition(":")
         try:
             with socket.create_connection((host, int(port)), timeout=timeout) as sock:
                 sock.settimeout(timeout)
-                _send_frame(sock, {"m": method, "p": payload})
-                reply = _recv_frame(sock)
+                _send_frame(sock, {"m": method, "p": payload}, self.auth)
+                # Replies are authenticated too: a spoofed leader cannot feed
+                # a keyed member forged directory state.
+                reply = _recv_frame(sock, self.auth)
         except RpcUnreachable:
             raise
+        except AuthError as e:
+            raise RpcUnreachable(f"{addr}: reply failed authentication: {e}") from e
         except (OSError, ValueError) as e:
             raise RpcUnreachable(f"{addr}: {e}") from e
         if not reply.get("ok"):

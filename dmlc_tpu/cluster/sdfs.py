@@ -62,7 +62,13 @@ def placement_order(name: str, candidates: list[str]) -> list[str]:
 
 
 class MemberStore:
-    """One node's local file store: real files on disk + a version map."""
+    """One node's local file store: real files on disk + a version map.
+
+    Staged puts live on DISK (``.staged/``), not in a RAM dict, and reads
+    can address byte ranges — so a put/fetch of a multi-GB checkpoint holds
+    O(chunk) memory at every hop (the reference streamed via scp from disk,
+    services.rs:244-262; round 2's in-RAM staging regressed that property).
+    """
 
     def __init__(self, storage_dir: str | Path):
         self.dir = Path(storage_dir)
@@ -70,22 +76,76 @@ class MemberStore:
         # not in any directory and would never be garbage-collected.
         shutil.rmtree(self.dir, ignore_errors=True)
         self.dir.mkdir(parents=True, exist_ok=True)
+        # exist_ok: the rmtree above is best-effort (ignore_errors) — a
+        # leftover scratch dir from a wipe that silently failed must not
+        # crash boot; stale files inside are unreferenced and harmless.
+        self._staged_dir = self.dir / ".staged"
+        self._staged_dir.mkdir(exist_ok=True)
+        self._incoming_dir = self.dir / ".incoming"
+        self._incoming_dir.mkdir(exist_ok=True)
         self.versions: dict[str, set[int]] = {}
-        self.staged: dict[str, bytes] = {}
+        self.staged: dict[str, Path] = {}
         self._lock = threading.RLock()
 
-    def stage(self, name: str, data: bytes) -> None:
-        """Hold bytes for an in-flight put until replicas pull them."""
-        with self._lock:
-            self.staged[name] = data
+    # ---- staging (put origin) ------------------------------------------
 
-    def unstage(self, name: str) -> None:
+    def _staged_path(self, key: str) -> Path:
+        return self._staged_dir / hashlib.sha256(key.encode()).hexdigest()[:32]
+
+    def stage(self, key: str, data: bytes) -> None:
+        """Hold bytes for an in-flight put until replicas pull them."""
+        path = self._staged_path(key)
+        path.write_bytes(data)
         with self._lock:
-            self.staged.pop(name, None)
+            self.staged[key] = path
+
+    def stage_file(self, key: str, src: str | Path) -> None:
+        """Stage an existing file by streaming copy — the whole-blob bytes
+        never enter this process's heap."""
+        path = self._staged_path(key)
+        shutil.copyfile(src, path)  # chunked copy, O(buffer) memory
+        with self._lock:
+            self.staged[key] = path
+
+    def unstage(self, key: str) -> None:
+        with self._lock:
+            path = self.staged.pop(key, None)
+        if path is not None:
+            path.unlink(missing_ok=True)
+
+    def staged_size(self, key: str) -> int:
+        with self._lock:
+            path = self.staged.get(key)
+        if path is None:
+            raise KeyError(f"nothing staged for {key!r}")
+        return path.stat().st_size
+
+    def staged_range(self, key: str, offset: int, length: int) -> bytes:
+        with self._lock:
+            path = self.staged.get(key)
+        if path is None:
+            raise KeyError(f"nothing staged for {key!r}")
+        with open(path, "rb") as f:
+            f.seek(offset)
+            return f.read(length)
+
+    # ---- stored versions -----------------------------------------------
 
     def receive(self, name: str, version: int, data: bytes) -> None:
         with self._lock:
             (self.dir / storage_filename(name, version)).write_bytes(data)
+            self.versions.setdefault(name, set()).add(version)
+
+    def incoming_path(self) -> Path:
+        """A scratch path for chunk-by-chunk assembly; pass the finished
+        file to ``adopt_file``. Caller owns cleanup on failure."""
+        return self._incoming_dir / uuid.uuid4().hex
+
+    def adopt_file(self, name: str, version: int, path: Path) -> None:
+        """Atomically install an assembled file as (name, version) — rename,
+        no copy, so a crash mid-transfer never leaves a half blob visible."""
+        with self._lock:
+            Path(path).rename(self.dir / storage_filename(name, version))
             self.versions.setdefault(name, set()).add(version)
 
     def read(self, name: str, version: int) -> bytes:
@@ -93,6 +153,21 @@ class MemberStore:
             if version not in self.versions.get(name, set()):
                 raise KeyError(f"{name} v{version} not stored here")
             return (self.dir / storage_filename(name, version)).read_bytes()
+
+    def size(self, name: str, version: int) -> int:
+        with self._lock:
+            if version not in self.versions.get(name, set()):
+                raise KeyError(f"{name} v{version} not stored here")
+            return (self.dir / storage_filename(name, version)).stat().st_size
+
+    def read_range(self, name: str, version: int, offset: int, length: int) -> bytes:
+        with self._lock:
+            if version not in self.versions.get(name, set()):
+                raise KeyError(f"{name} v{version} not stored here")
+            path = self.dir / storage_filename(name, version)
+        with open(path, "rb") as f:
+            f.seek(offset)
+            return f.read(length)
 
     def delete(self, name: str) -> None:
         with self._lock:
@@ -104,24 +179,68 @@ class MemberStore:
             return {n: sorted(vs) for n, vs in self.versions.items()}
 
 
-class SdfsMember:
-    """Member-side RPC surface: receive/fetch/replicate-pull/delete/store."""
+# Bytes per transfer frame. Blobs larger than this move as a sequence of
+# range-read RPCs streamed straight to/from disk — no hop ever holds the
+# whole blob in memory, and no frame approaches the fabric's MAX_FRAME.
+DEFAULT_CHUNK_BYTES = 8 * 1024 * 1024
 
-    def __init__(self, store: MemberStore, rpc: Rpc):
+
+class SdfsMember:
+    """Member-side RPC surface: receive/fetch/replicate-pull/delete/store.
+
+    Bulk bytes move in bounded chunks (``chunk_bytes``): ``fetch_meta`` +
+    ``fetch_chunk`` are range reads against the on-disk blob, and
+    ``_replicate`` assembles pulled chunks into a scratch file adopted by
+    rename — the scp streaming shape (services.rs:244-262) rebuilt on the
+    RPC fabric, preserving its O(chunk) memory property.
+    """
+
+    def __init__(self, store: MemberStore, rpc: Rpc, chunk_bytes: int = DEFAULT_CHUNK_BYTES):
         self.store = store
         self.rpc = rpc
+        self.chunk_bytes = chunk_bytes
+        # Highest leadership epoch seen on any write (failover.epoch_key
+        # order): writes carrying an OLDER term are rejected — a stale
+        # claimant on the wrong side of a candidate partition cannot land
+        # (or overwrite) blobs here. None until the first fenced write.
+        self._fence: tuple[int, str] | None = None
+        self._fence_lock = threading.Lock()
+
+    def _check_epoch(self, p: dict) -> None:
+        from dmlc_tpu.cluster.failover import epoch_key
+
+        epoch = p.get("epoch")
+        if epoch is None:
+            return  # unfenced caller (standalone leader/tools): legacy-open
+        key = epoch_key(epoch)
+        with self._fence_lock:
+            if self._fence is not None and key < self._fence:
+                raise RpcError(
+                    f"stale leadership epoch {list(key)} < fenced {list(self._fence)}"
+                )
+            self._fence = max(self._fence or key, key)
+
+    def _fence_rpc(self, p: dict) -> dict:
+        self._check_epoch(p)
+        with self._fence_lock:
+            return {"epoch": list(self._fence) if self._fence else None}
 
     def methods(self) -> dict:
         return {
+            "sdfs.fence": self._fence_rpc,
             "sdfs.receive": self._receive,
             "sdfs.fetch": self._fetch,
-            "sdfs.fetch_stage": self._fetch_stage,
+            "sdfs.fetch_meta": self._fetch_meta,
+            "sdfs.fetch_chunk": self._fetch_chunk,
+            "sdfs.fetch_stage_meta": self._fetch_stage_meta,
+            "sdfs.fetch_stage_chunk": self._fetch_stage_chunk,
             "sdfs.replicate": self._replicate,
             "sdfs.delete": self._delete,
             "sdfs.store": self._store,
         }
 
     def _receive(self, p: dict) -> dict:
+        self._check_epoch(p)
         self.store.receive(p["name"], int(p["version"]), p["data"])
         return {}
 
@@ -131,27 +250,78 @@ class SdfsMember:
         except KeyError as e:
             raise RpcError(str(e))
 
-    def _fetch_stage(self, p: dict) -> dict:
-        data = self.store.staged.get(p["name"])
-        if data is None:
-            raise RpcError(f"nothing staged for {p['name']!r}")
-        return {"data": data}
+    def _fetch_meta(self, p: dict) -> dict:
+        try:
+            return {"size": self.store.size(p["name"], int(p["version"]))}
+        except KeyError as e:
+            raise RpcError(str(e))
+
+    def _fetch_chunk(self, p: dict) -> dict:
+        try:
+            return {
+                "data": self.store.read_range(
+                    p["name"], int(p["version"]), int(p["offset"]), int(p["length"])
+                )
+            }
+        except KeyError as e:
+            raise RpcError(str(e))
+
+    def _fetch_stage_meta(self, p: dict) -> dict:
+        try:
+            return {"size": self.store.staged_size(p["name"])}
+        except KeyError as e:
+            raise RpcError(str(e))
+
+    def _fetch_stage_chunk(self, p: dict) -> dict:
+        try:
+            return {
+                "data": self.store.staged_range(
+                    p["name"], int(p["offset"]), int(p["length"])
+                )
+            }
+        except KeyError as e:
+            raise RpcError(str(e))
 
     def _replicate(self, p: dict) -> dict:
         """Third-party copy: pull from ``source`` and store locally. This is
-        the scp-orchestration shape (services.rs:264-272) over RPC."""
+        the scp-orchestration shape (services.rs:264-272) over RPC. Large
+        blobs stream chunk-by-chunk into a scratch file; small ones ride one
+        frame."""
+        self._check_epoch(p)
         name, version, source = p["name"], int(p["version"]), p["source"]
         if p.get("from_stage"):
             key = p.get("stage_key") or name
-            data = self.rpc.call(source, "sdfs.fetch_stage", {"name": key})["data"]
+            meta, chunk = "sdfs.fetch_stage_meta", "sdfs.fetch_stage_chunk"
+            ident: dict = {"name": key}
         else:
-            data = self.rpc.call(
-                source, "sdfs.fetch", {"name": name, "version": version}
-            )["data"]
-        self.store.receive(name, version, data)
+            meta, chunk = "sdfs.fetch_meta", "sdfs.fetch_chunk"
+            ident = {"name": name, "version": version}
+        size = int(self.rpc.call(source, meta, ident)["size"])
+        if size <= self.chunk_bytes:
+            data = self.rpc.call(source, chunk, {**ident, "offset": 0, "length": size})["data"]
+            self.store.receive(name, version, data)
+            return {}
+        scratch = self.store.incoming_path()
+        try:
+            with open(scratch, "wb") as f:
+                for offset in range(0, size, self.chunk_bytes):
+                    part = self.rpc.call(
+                        source,
+                        chunk,
+                        {**ident, "offset": offset,
+                         "length": min(self.chunk_bytes, size - offset)},
+                    )["data"]
+                    f.write(part)
+            if scratch.stat().st_size != size:
+                raise RpcError(f"assembled {scratch.stat().st_size} bytes, wanted {size}")
+            self.store.adopt_file(name, version, scratch)
+        except BaseException:
+            scratch.unlink(missing_ok=True)
+            raise
         return {}
 
     def _delete(self, p: dict) -> dict:
+        self._check_epoch(p)
         self.store.delete(p["name"])
         return {}
 
@@ -200,11 +370,19 @@ class SdfsLeader:
     """
 
     def __init__(
-        self, rpc: Rpc, active_members, replication_factor: int = 4, is_leading: bool = True
+        self,
+        rpc: Rpc,
+        active_members,
+        replication_factor: int = 4,
+        is_leading: bool = True,
+        fanout: int = 4,
     ):
         self.rpc = rpc
         self.active_members = active_members
         self.rf = replication_factor
+        # Concurrent replica copies per placement (the reference ran its scp
+        # fanout 10-wide, services.rs:367-373); 1 = fully sequential.
+        self.fanout = max(1, fanout)
         self.state = SdfsLeaderState()
         self._lock = threading.RLock()
         # Writes are refused unless actively leading (set by StandbyLeader on
@@ -213,10 +391,23 @@ class SdfsLeader:
         # an acked write silently lost. Standalone single-leader use (tests,
         # local tools) passes the default True.
         self.is_leading = is_leading
+        # Leadership epoch [counter, claimant] stamped on every member write
+        # (and replicated with the directory): members fence out older
+        # terms, so a stale claimant's placements bounce instead of landing.
+        # Standalone use (tests, tools) keeps the default term.
+        self.epoch: list = [1, ""]
         # Highest version handed out per file, including puts still in
         # flight — concurrent puts of one name must get distinct versions
         # even though the directory records them only after replication.
         self._reserved: dict[str, int] = {}
+        # Delete tombstones: name -> version watermark at delete time,
+        # replicated with the directory. reconcile_from_members skips
+        # member-held versions at or below the watermark, so a replica that
+        # missed the delete (unreachable, tolerated) cannot resurrect the
+        # file through a promotion-time inventory sync; versions stay
+        # monotonic past a delete (the reservation keeps the watermark), so
+        # re-created files are never shadowed by their own tombstone.
+        self._tombstones: dict[str, int] = {}
 
     def methods(self) -> dict:
         return {
@@ -240,13 +431,76 @@ class SdfsLeader:
         reservation map rides along so concurrent-put protection survives
         failover instead of resetting."""
         with self._lock:
-            return {"directory": self.state.to_wire(), "reserved": dict(self._reserved)}
+            return {
+                "directory": self.state.to_wire(),
+                "reserved": dict(self._reserved),
+                "tombstones": dict(self._tombstones),
+                "epoch": list(self.epoch),
+            }
 
     def adopt_state(self, wire: dict) -> None:
         """Standby sync: mirror the active leader's directory wholesale."""
         with self._lock:
             self.state = SdfsLeaderState.from_wire(wire["directory"])
             self._reserved = {k: int(v) for k, v in wire.get("reserved", {}).items()}
+            self._tombstones = {
+                k: int(v) for k, v in wire.get("tombstones", {}).items()
+            }
+
+    def _for_each_member(self, what: str, fn) -> list:
+        """Run fn(member) across active members CONCURRENTLY (bounded by
+        fanout), tolerating per-member failure. Promotion-time passes use
+        this: members are most likely to be unreachable exactly then, and a
+        serial 2 s timeout per dead member would stall leadership takeover
+        O(members x timeout)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        members = self.active_members()
+        results = []
+        with ThreadPoolExecutor(max_workers=max(self.fanout, 4)) as pool:
+            for m, fut in [(m, pool.submit(fn, m)) for m in members]:
+                try:
+                    results.append((m, fut.result()))
+                except (RpcUnreachable, RpcError) as e:
+                    log.warning("%s %s failed: %s", what, m, e)
+        return results
+
+    def fence_members(self) -> None:
+        """Best-effort fence announcement to every reachable member: they
+        learn this term before it accepts writes, so a stale claimant's
+        subsequent placements are rejected rather than raced."""
+        self._for_each_member(
+            "fence",
+            lambda m: self.rpc.call(
+                m, "sdfs.fence", {"epoch": list(self.epoch)}, timeout=2.0
+            ),
+        )
+
+    def reconcile_from_members(self) -> None:
+        """Promotion-time inventory sync: fold every reachable member's
+        store listing into the directory and raise version reservations to
+        cover what exists ON DISK — versions acked by a previous term that
+        this candidate never mirrored (leader died between ack and standby
+        sync) can then never be re-handed to a new put as fresh numbers,
+        so one version number can never name two different blobs. Must run
+        AFTER fence_members(): any stale-term write a member accepts lands
+        before its fence, hence before this read of its listing."""
+        listings = self._for_each_member(
+            "reconcile", lambda m: self.rpc.call(m, "sdfs.store", {}, timeout=2.0)
+        )
+        for m, reply in listings:
+            files = reply["files"]
+            with self._lock:
+                for name, versions in files.items():
+                    # A replica that missed a delete still lists the dead
+                    # blob; the tombstone watermark keeps it dead.
+                    dead_below = self._tombstones.get(name, 0)
+                    live = [int(v) for v in versions if int(v) > dead_below]
+                    for v in live:
+                        self.state.record(name, v, m)
+                    top = max(live, default=0)
+                    if top > self._reserved.get(name, 0):
+                        self._reserved[name] = top
 
     # ---- RPC methods ---------------------------------------------------
 
@@ -324,18 +578,22 @@ class SdfsLeader:
             self._require_leading()
             entry = self.state.directory.pop(name, {})
             members = sorted(entry)
-            # Reservation pruning, guarded against an in-flight put: a live
-            # reservation is strictly newer than anything in the directory,
-            # and dropping it would let the next put reuse that version
-            # number for different bytes.
+            # Tombstone at the high-water mark (directory AND in-flight
+            # reservations): reconcile_from_members must never resurrect
+            # any version a replica kept past this delete, and the
+            # reservation stays AT the watermark so the next put of this
+            # name gets a strictly newer number — one version can then
+            # never name both a deleted blob and a re-created one.
             latest = max((v for vs in entry.values() for v in vs), default=0)
-            if self._reserved.get(name, 0) <= latest:
-                self._reserved.pop(name, None)
+            watermark = max(latest, self._reserved.get(name, 0))
+            if watermark > 0:
+                self._tombstones[name] = watermark
+                self._reserved[name] = watermark
         failed = []
         for m in members:
             try:
-                self.rpc.call(m, "sdfs.delete", {"name": name})
-            except RpcUnreachable:
+                self.rpc.call(m, "sdfs.delete", {"name": name, "epoch": list(self.epoch)})
+            except (RpcUnreachable, RpcError):
                 failed.append(m)  # its boot-time store wipe will collect it
         return {"deleted_from": [m for m in members if m not in failed]}
 
@@ -360,21 +618,25 @@ class SdfsLeader:
         """Copy (name, version) onto members chosen by hash + linear probe
         until rf replicas exist: pulled member-to-member from ``source``,
         or pushed directly when the bytes arrived inline (``data``).
-        Unreachable candidates are probed past, like failed scp targets
-        (services.rs:367-394)."""
+        Up to ``fanout`` copies run concurrently (services.rs:367-373 ran
+        its scp fanout 10-wide); unreachable candidates are probed past,
+        like failed scp targets (services.rs:367-394)."""
+        from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+
         with self._lock:
             have = set(self.state.replicas_of(name, version))
         live = self.active_members()
         placed = sorted(have)
-        for candidate in placement_order(name, [m for m in live if m not in have]):
-            if len(placed) >= self.rf:
-                break
+        candidates = iter(placement_order(name, [m for m in live if m not in have]))
+
+        def copy_to(candidate: str) -> bool:
             try:
                 if data is not None:
                     self.rpc.call(
                         candidate,
                         "sdfs.receive",
-                        {"name": name, "version": version, "data": data},
+                        {"name": name, "version": version, "data": data,
+                         "epoch": list(self.epoch)},
                     )
                 else:
                     self.rpc.call(
@@ -386,14 +648,34 @@ class SdfsLeader:
                             "source": source,
                             "from_stage": from_stage,
                             "stage_key": stage_key,
+                            "epoch": list(self.epoch),
                         },
                     )
+                return True
             except (RpcUnreachable, RpcError) as e:
                 log.warning("replicate %s v%s -> %s failed: %s", name, version, candidate, e)
-                continue
-            with self._lock:
-                self.state.record(name, version, candidate)
-            placed.append(candidate)
+                return False
+
+        with ThreadPoolExecutor(max_workers=self.fanout) as pool:
+            pending: set = set()
+
+            def refill() -> None:
+                while len(placed) + len(pending) < self.rf:
+                    c = next(candidates, None)
+                    if c is None:
+                        return
+                    pending.add(pool.submit(lambda c=c: (c, copy_to(c))))
+
+            refill()
+            while pending:
+                done, pending = wait(pending, return_when=FIRST_COMPLETED)
+                for fut in done:
+                    candidate, ok = fut.result()
+                    if ok:
+                        with self._lock:
+                            self.state.record(name, version, candidate)
+                        placed.append(candidate)
+                refill()
         return placed
 
     def heal_once(self) -> int:
@@ -432,22 +714,38 @@ class SdfsLeader:
 
 class SdfsClient:
     """Client verbs against a leader + the member fabric. ``self_addr`` is
-    this node's member RPC address (the staging origin for puts)."""
+    this node's member RPC address (the staging origin for puts). Bulk bytes
+    stream disk-to-disk in bounded chunks at every hop."""
 
-    def __init__(self, rpc: Rpc, leader_addr: str, store: MemberStore, self_addr: str):
+    def __init__(
+        self,
+        rpc: Rpc,
+        leader_addr: str,
+        store: MemberStore,
+        self_addr: str,
+        chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+    ):
         self.rpc = rpc
         self.leader_addr = leader_addr
         self.local_store = store
         self.self_addr = self_addr
+        self.chunk_bytes = chunk_bytes
 
     def put(self, local_path: str | Path, name: str) -> dict:
-        return self.put_bytes(Path(local_path).read_bytes(), name)
+        # Streaming-copy the file into the stage area — the blob never
+        # enters this process's heap, whatever its size.
+        key = f"{name}#{uuid.uuid4().hex}"
+        self.local_store.stage_file(key, local_path)
+        return self._put_staged(key, name)
 
     def put_bytes(self, data: bytes, name: str) -> dict:
         # Unique stage key per put: concurrent puts of the same name from
         # this client must not overwrite each other's staged bytes.
         key = f"{name}#{uuid.uuid4().hex}"
         self.local_store.stage(key, data)
+        return self._put_staged(key, name)
+
+    def _put_staged(self, key: str, name: str) -> dict:
         try:
             return self.rpc.call(
                 self.leader_addr,
@@ -461,29 +759,51 @@ class SdfsClient:
         info = self.rpc.call(
             self.leader_addr, "sdfs.get", {"name": name, "version": version}
         )
-        data = self._pull(name, info["version"], info["replicas"])
-        Path(local_path).write_bytes(data)
+        self._pull_to_path(local_path, lambda f: self._pull_to(
+            name, info["version"], info["replicas"], f
+        ))
         return info["version"]
 
     def get_bytes(self, name: str, version: int | None = None) -> tuple[int, bytes]:
+        import io
+
         info = self.rpc.call(
             self.leader_addr, "sdfs.get", {"name": name, "version": version}
         )
-        return info["version"], self._pull(name, info["version"], info["replicas"])
+        buf = io.BytesIO()
+        self._pull_to(name, info["version"], info["replicas"], buf)
+        return info["version"], buf.getvalue()
 
     def get_versions(self, name: str, n: int, local_path: str | Path) -> list[int]:
         """Fetch the last n versions merged newest-first into one file with
         '== Version N ==' delimiters (services.rs:555-569)."""
         reply = self.rpc.call(self.leader_addr, "sdfs.get_versions", {"name": name, "n": n})
-        chunks: list[bytes] = []
         versions: list[int] = []
-        for v_str, replicas in sorted(reply["versions"].items(), key=lambda kv: -int(kv[0])):
-            v = int(v_str)
-            chunks.append(f"== Version {v} ==\n".encode())
-            chunks.append(self._pull(name, v, replicas))
-            versions.append(v)
-        Path(local_path).write_bytes(b"".join(chunks))
+
+        def pull_all(f) -> None:
+            for v_str, replicas in sorted(reply["versions"].items(), key=lambda kv: -int(kv[0])):
+                v = int(v_str)
+                f.write(f"== Version {v} ==\n".encode())
+                self._pull_to(name, v, replicas, f)
+                versions.append(v)
+
+        self._pull_to_path(local_path, pull_all)
         return versions
+
+    @staticmethod
+    def _pull_to_path(local_path: str | Path, pull) -> None:
+        """Stream into a sibling temp file and rename over ``local_path``
+        only on success — a failed get must never destroy the caller's
+        existing copy (which may be its fallback)."""
+        local_path = Path(local_path)
+        tmp = local_path.with_name(f".{local_path.name}.{uuid.uuid4().hex[:8]}.part")
+        try:
+            with open(tmp, "wb") as f:
+                pull(f)
+            tmp.replace(local_path)
+        except BaseException:
+            tmp.unlink(missing_ok=True)
+            raise
 
     def delete(self, name: str) -> dict:
         return self.rpc.call(self.leader_addr, "sdfs.delete", {"name": name})
@@ -495,11 +815,31 @@ class SdfsClient:
         addr = member_addr or self.self_addr
         return self.rpc.call(addr, "sdfs.store", {})["files"]
 
-    def _pull(self, name: str, version: int, replicas: list[str]) -> bytes:
+    def _pull_to(self, name: str, version: int, replicas: list[str], f) -> None:
+        """Stream one replica's blob into seekable ``f`` in bounded chunks;
+        on mid-stream failure, rewind and retry the next replica."""
         last: Exception | None = None
+        start = f.tell()
         for r in replicas:
             try:
-                return self.rpc.call(r, "sdfs.fetch", {"name": name, "version": version})["data"]
+                size = int(
+                    self.rpc.call(r, "sdfs.fetch_meta", {"name": name, "version": version})["size"]
+                )
+                f.seek(start)
+                f.truncate(start)
+                for offset in range(0, size, self.chunk_bytes):
+                    part = self.rpc.call(
+                        r,
+                        "sdfs.fetch_chunk",
+                        {
+                            "name": name,
+                            "version": version,
+                            "offset": offset,
+                            "length": min(self.chunk_bytes, size - offset),
+                        },
+                    )["data"]
+                    f.write(part)
+                return
             except (RpcUnreachable, RpcError) as e:
                 last = e
         raise RpcError(f"no live replica served {name!r} v{version}: {last}")

@@ -48,6 +48,13 @@ class ClusterConfig:
     storage_dir: str = "storage"        # src/services.rs:34
     replication_factor: int = 4         # src/services.rs:328,359
     rereplication_interval_s: float = 3.0  # src/services.rs:188
+    # Bulk-transfer frame size: blobs larger than this stream disk-to-disk
+    # as bounded range-read RPCs (the reference streamed via scp from disk,
+    # services.rs:244-262); every hop holds O(chunk) memory.
+    transfer_chunk_bytes: int = 8 * 1024 * 1024
+    # Concurrent replica copies per placement (reference: 10-way scp fanout,
+    # services.rs:367-373).
+    replicate_fanout: int = 4
 
     # --- scheduler ---
     assignment_interval_s: float = 3.0  # src/services.rs:201
@@ -89,6 +96,13 @@ class ClusterConfig:
     # building the model from source — the native-serving deployment shape
     # (models/export.py): members need only the artifact + weights blobs.
     serve_from_executable: bool = False
+
+    # --- control-plane authentication (cluster/auth.py) ---
+    # Shared fleet key: every RPC frame and gossip datagram carries an
+    # HMAC-SHA256 tag, and unauthenticated frames are dropped — reaching a
+    # port no longer grants sdfs.delete / job.start (the reference leaned on
+    # fleet ssh trust instead, services.rs:244-272). "" disables.
+    auth_key: str = ""
 
     # --- multi-host global device mesh (parallel/multihost.py) ---
     # >1 enables leader-coordinated jax.distributed bootstrap: members call

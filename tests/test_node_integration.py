@@ -100,6 +100,34 @@ def test_full_stack_through_cli(cluster3, tmp_path):
     assert "usage" in cli.run_command("put onlyonearg")
 
 
+def test_authenticated_cluster_end_to_end(tmp_path):
+    """A fleet sharing auth_key converges, replicates, and serves jobs with
+    every gossip datagram and RPC frame HMAC-tagged — and an unkeyed caller
+    cannot reach the leader's methods."""
+    import pytest
+
+    from dmlc_tpu.cluster.rpc import RpcUnreachable, TcpRpc
+
+    nodes = start_local_cluster(tmp_path, n_nodes=3, auth_key="fleet-secret")
+    try:
+        cli = Cli(nodes[1])
+        assert cli.run_command("lm").count("active") == 3
+
+        src = tmp_path / "w.bin"
+        src.write_bytes(b"keyed-bytes")
+        cli.run_command(f"put {src} models/keyed")
+        dst = tmp_path / "out.bin"
+        cli.run_command(f"get models/keyed {dst}")
+        assert dst.read_bytes() == b"keyed-bytes"
+
+        # The whole point: reaching the port without the key gets silence.
+        leader = nodes[0].self_leader_addr
+        with pytest.raises(RpcUnreachable):
+            TcpRpc().call(leader, "sdfs.delete", {"name": "models/keyed"}, timeout=2.0)
+    finally:
+        stop_local_cluster(nodes)
+
+
 def test_leader_failover_resumes_jobs(cluster3, tmp_path):
     nodes = cluster3
     leader, standby, member = nodes

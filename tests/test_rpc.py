@@ -69,6 +69,51 @@ class TestTcp:
         with pytest.raises(RpcUnreachable):
             rpc.call("127.0.0.1:1", "echo", {}, timeout=0.5)
 
+    def test_authenticated_roundtrip(self):
+        from dmlc_tpu.cluster.auth import FrameAuth
+
+        server = TcpRpcServer("127.0.0.1", 0, echo_methods(), auth=FrameAuth("k1"))
+        try:
+            rpc = TcpRpc(auth=FrameAuth("k1"))
+            assert rpc.call(server.address, "echo", {"k": "v"}) == {"echo": {"k": "v"}}
+            with pytest.raises(RpcError, match="kapow"):
+                rpc.call(server.address, "boom", {})
+        finally:
+            server.close()
+
+    def test_unauthenticated_frames_rejected(self):
+        from dmlc_tpu.cluster.auth import FrameAuth
+
+        server = TcpRpcServer("127.0.0.1", 0, echo_methods(), auth=FrameAuth("k1"))
+        try:
+            # No key: the server drops the connection without a reply — the
+            # caller learns nothing (no error oracle), and the method never
+            # ran.
+            with pytest.raises(RpcUnreachable):
+                TcpRpc().call(server.address, "echo", {}, timeout=2.0)
+            # Wrong key: same silence.
+            with pytest.raises(RpcUnreachable):
+                TcpRpc(auth=FrameAuth("other")).call(server.address, "echo", {}, timeout=2.0)
+            # The server survives both and still answers a keyed caller.
+            rpc = TcpRpc(auth=FrameAuth("k1"))
+            assert rpc.call(server.address, "echo", {}) == {"echo": {}}
+        finally:
+            server.close()
+
+    def test_keyed_client_rejects_unkeyed_server(self):
+        from dmlc_tpu.cluster.auth import FrameAuth
+
+        server = TcpRpcServer("127.0.0.1", 0, echo_methods())  # no auth
+        try:
+            # Mutual: a keyed member never completes a call against an
+            # unkeyed (spoofed) server — either the server drops the sealed
+            # frame as malformed (this path) or, if it answered, the untagged
+            # reply would fail the client's check.
+            with pytest.raises(RpcUnreachable):
+                TcpRpc(auth=FrameAuth("k1")).call(server.address, "echo", {}, timeout=2.0)
+        finally:
+            server.close()
+
     def test_server_survives_malformed_client(self):
         server = TcpRpcServer("127.0.0.1", 0, echo_methods())
         try:

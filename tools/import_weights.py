@@ -49,6 +49,9 @@ def main(argv=None) -> int:
     parser.add_argument("checkpoint", type=Path, help=".pth/.pt/.bin/.npz state dict")
     parser.add_argument("--leader", help="leader RPC address host:port to publish via")
     parser.add_argument("--out", type=Path, help="write the blob locally instead")
+    parser.add_argument(
+        "--auth-key", default="", help="fleet auth key (ClusterConfig.auth_key)"
+    )
     args = parser.parse_args(argv)
     if not args.leader and not args.out:
         parser.error("need --leader (publish) or --out (local blob)")
@@ -65,11 +68,12 @@ def main(argv=None) -> int:
         print(f"wrote {args.out}; publish with: put {args.out} {weights_lib.sdfs_weights_name(args.model)}")
         return 0
 
+    from dmlc_tpu.cluster.auth import maybe_auth
     from dmlc_tpu.cluster.rpc import TcpRpc
 
     # A standalone tool has no member store to stage bytes in, so the blob
     # rides the request itself and the leader pushes it to the replicas.
-    reply = TcpRpc().call(
+    reply = TcpRpc(auth=maybe_auth(args.auth_key)).call(
         args.leader,
         "sdfs.put_inline",
         {"name": weights_lib.sdfs_weights_name(args.model), "data": blob},
