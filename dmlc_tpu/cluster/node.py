@@ -61,19 +61,43 @@ class ClusterNode:
     """One running node: membership + member services + optional leadership."""
 
     def __init__(self, config: ClusterConfig, backends: dict | None = None):
+        # If construction fails after some ports are bound (e.g. EADDRINUSE
+        # on member_port after gossip bound), the caller never gets a handle
+        # to stop() — close whatever bound before re-raising so a harness
+        # retry can redraw the port block without leaking sockets.
+        self.gossip = None
+        self.member_server = None
+        self.leader_server = None
+        try:
+            self._build(config, backends)
+        except BaseException:
+            for bound in (self.leader_server, self.member_server, self.gossip):
+                if bound is not None:
+                    try:
+                        bound.close()
+                    except Exception:
+                        pass
+            raise
+
+    def _build(self, config: ClusterConfig, backends: dict | None) -> None:
+        from dmlc_tpu.cluster.auth import maybe_auth
+
         self.config = config
         self.clock = Clock()
-        self.rpc = TcpRpc()
+        self.auth = maybe_auth(config.auth_key)
+        self.rpc = TcpRpc(auth=self.auth)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
 
         # --- L1 membership over UDP gossip -----------------------------
-        self.gossip = UdpTransport(config.host, config.gossip_port)
+        self.gossip = UdpTransport(config.host, config.gossip_port, auth=self.auth)
         self.membership = MembershipNode(config, self.gossip, self.clock)
 
         # --- member services (SDFS store + inference worker) -----------
         self.store = MemberStore(Path(config.storage_dir))
-        self.sdfs_member = SdfsMember(self.store, self.rpc)
+        self.sdfs_member = SdfsMember(
+            self.store, self.rpc, chunk_bytes=config.transfer_chunk_bytes
+        )
         if backends is None:
             if config.serve_from_executable:
                 # sdfs is wired in below once the client exists (the member
@@ -98,7 +122,7 @@ class ClusterNode:
             **self.model_loader.methods(),
             "node.info": self._node_info,
         }
-        self.member_server = TcpRpcServer(config.host, config.member_port, methods)
+        self.member_server = TcpRpcServer(config.host, config.member_port, methods, auth=self.auth)
         self.self_member_addr = self.member_server.address
 
         # --- leader-candidate machinery --------------------------------
@@ -117,7 +141,11 @@ class ClusterNode:
             self._start_leader_services()
 
         self.sdfs = SdfsClient(
-            self.rpc, self.tracker.current, self.store, self.self_member_addr
+            self.rpc,
+            self.tracker.current,
+            self.store,
+            self.self_member_addr,
+            chunk_bytes=config.transfer_chunk_bytes,
         )
         for backend in self.worker.backends.values():
             if isinstance(backend, ExportedBackend) and backend.sdfs is None:
@@ -156,6 +184,7 @@ class ClusterNode:
             # candidate's SDFS surface refuses writes (they would be lost to
             # the next directory sync).
             is_leading=False,
+            fanout=self.config.replicate_fanout,
         )
         self._weight_cache: dict[str, tuple[int, float]] = {}
         self.scheduler = JobScheduler(
@@ -165,6 +194,7 @@ class ClusterNode:
             shard_size=self.config.dispatch_shard_size,
             member_weight=self._member_weight,
             hedge_tail=self.config.hedge_tail,
+            mesh_group=self._mesh_group,
         )
         methods = {**self.sdfs_leader.methods(), **self.scheduler.methods()}
         if self.config.mesh_processes > 1:
@@ -176,7 +206,9 @@ class ClusterNode:
                 is_leading=False,  # promoted with the rest by StandbyLeader
             )
             methods.update(self.mesh_bootstrap.methods())
-        self.leader_server = TcpRpcServer(self.config.host, self.config.leader_port, methods)
+        self.leader_server = TcpRpcServer(
+            self.config.host, self.config.leader_port, methods, auth=self.auth
+        )
         # Leadership is claimed via StandbyLeader.step(), never assumed at
         # boot: a restarted ex-leader must defer to whoever promoted while
         # it was down instead of double-leading.
@@ -190,6 +222,15 @@ class ClusterNode:
         )
 
     # ---- topology ------------------------------------------------------
+
+    def _mesh_group(self):
+        """Scheduler hook: {member_addr: mesh rank} once the fleet's global
+        jax.distributed runtime is fully registered (members register with
+        their member RPC address, join_global_mesh), else None — the
+        scheduler then gang-dispatches shards to the whole mesh as one
+        collective execution instead of per-member silos."""
+        mb = self.mesh_bootstrap
+        return None if mb is None else mb.group()
 
     def _node_info(self, p: dict) -> dict:
         """Member RPC: this host's chip capacity, for the leader's

@@ -72,6 +72,15 @@ class MeshBootstrap:
         with self._lock:
             self.ranks = {str(a): int(r) for a, r in wire["ranks"].items()}
 
+    def group(self) -> dict | None:
+        """{addr: rank} once every expected process has registered, else
+        None — the scheduler's gang-dispatch readiness check (keeps the
+        ready invariant here instead of in callers)."""
+        with self._lock:
+            if len(self.ranks) < self.num_processes:
+                return None
+            return dict(self.ranks)
+
     def _register(self, p: dict) -> dict:
         addr = p["addr"]
         with self._lock:

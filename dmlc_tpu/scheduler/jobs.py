@@ -37,6 +37,7 @@ import threading
 from dataclasses import dataclass, field
 
 from dmlc_tpu.cluster.rpc import Rpc, RpcError, RpcUnreachable
+from dmlc_tpu.scheduler.worker import gang_slice
 from dmlc_tpu.utils.metrics import LatencyStats
 from dmlc_tpu.utils.tracing import tracer
 
@@ -64,6 +65,11 @@ class Job:
     # reference's `jobs` report aggregated only per job).
     member_stats: dict = field(default_factory=dict)
     _next_member: int = 0
+    # Cached shard_stats p50 for hedge eligibility: the percentile is a sort
+    # of up to 4096 reservoir samples, and the check runs on every idle
+    # dispatcher poll under the scheduler lock — recompute only after a new
+    # sample lands (None = dirty).
+    _median_cache: float | None = None
     # --- in-flight bookkeeping (leader-local, never replicated) ---------
     next_offset: int = 0                      # reservation cursor
     outstanding: dict = field(default_factory=dict)   # offset -> {members in flight}
@@ -71,6 +77,17 @@ class Job:
     retry_q: list = field(default_factory=list)       # [(offset, excluded members)]
     failed: dict = field(default_factory=dict)        # offset -> {members that failed it}
     dispatch_t: dict = field(default_factory=dict)    # offset -> first-dispatch stamp
+    # Shards completed via gang dispatch (one collective SPMD execution
+    # across the whole mesh group) this term — the jobs report's evidence
+    # that the mesh group is serving collectively.
+    gang_shards: int = 0
+    # Consecutive gang failures with no success in between. A config-level
+    # incompatibility (e.g. shard slice exceeding the engines' per-process
+    # batch cap) fails INSTANTLY on every member, so unbounded whole-gang
+    # retry would busy-loop forever; past a small cap the job is stopped
+    # with the error surfaced in the report instead.
+    gang_consec_failures: int = 0
+    last_error: str = ""
     # Wall-clock throughput window (leader-local, this term only): first
     # dispatch and latest completion stamps from the scheduler's timer.
     first_dispatch_t: float | None = None
@@ -117,6 +134,8 @@ class Job:
             "accuracy": self.accuracy,
             "throughput_qps": self.throughput_qps,
             "assigned": list(self.assigned),
+            "gang_shards": self.gang_shards,
+            "last_error": self.last_error,
             "query_latency": self.query_stats.summary(),
             "shard_latency": self.shard_stats.summary(),
             "member_latency": {m: s.summary() for m, s in self.member_stats.items()},
@@ -139,6 +158,7 @@ class Job:
         self.running = bool(w["running"])
         self.query_stats = LatencyStats.from_wire(w["query_samples"])
         self.shard_stats = LatencyStats.from_wire(w["shard_samples"])
+        self._median_cache = None
         self.reset_inflight()
         # The throughput window is term-local: a new leader measures its own
         # dispatch rate, not wall time since a dead leader's first shard.
@@ -164,6 +184,7 @@ class JobScheduler:
         shard_timeout_s: float = 120.0,
         member_weight=None,
         hedge_tail: bool = True,
+        mesh_group=None,
     ):
         import time
 
@@ -188,12 +209,29 @@ class JobScheduler:
         # star's "per-host chip topology"); default: every host weight 1
         # (the reference's uniform random pick, services.rs:414-416).
         self.member_weight = member_weight or (lambda addr: 1)
+        # Gang scheduling over the global device mesh: a callable returning
+        # {member_addr: mesh rank} once the fleet's jax.distributed runtime
+        # is fully registered (None before). A job whose assigned members
+        # are exactly a registered mesh group dispatches each shard to ALL
+        # of them at once — one collective SPMD execution per shard
+        # (InferenceEngine.run_batch_global) instead of per-member silos.
+        # This is the scheduler DRIVING distributed inference, the
+        # reference's whole point (services.rs:407-433) at mesh scale.
+        self.mesh_group = mesh_group
+        # One gang shard in flight at a time: two concurrent collectives
+        # over one mesh would interleave their participants and deadlock.
+        self._gang_lock = threading.Lock()
+        self.gang_max_consec_failures = 8
         self.jobs: dict[str, Job] = {
             name: Job(model_name=name, queries=list(qs)) for name, qs in jobs.items()
         }
         # Set by StandbyLeader on promotion; other candidates read it via
         # leader.status to defer instead of double-leading.
         self.is_leading = False
+        # Leadership epoch [counter, claimant] (failover.epoch_key order),
+        # set at promotion; candidates compare terms to know who abdicates
+        # after a candidate partition heals.
+        self.epoch: list = [0, ""]
         self._lock = threading.RLock()
 
     # ---- RPC surface ---------------------------------------------------
@@ -205,7 +243,7 @@ class JobScheduler:
             "job.state": self._state,
             "job.assignments": self._assignments,
             "leader.alive": lambda p: {"ok": True},
-            "leader.status": lambda p: {"leading": self.is_leading},
+            "leader.status": lambda p: {"leading": self.is_leading, "epoch": list(self.epoch)},
         }
 
     def _start_rpc(self, p: dict) -> dict:
@@ -225,6 +263,10 @@ class JobScheduler:
                     # cursor; in-flight work from a dead term is abandoned
                     # (re-dispatched shards dedup by offset anyway).
                     job.next_offset = max(job.next_offset, job.finished)
+                    # Re-arm a job the gang breaker stopped: `predict` is
+                    # the operator's explicit retry after fixing the config.
+                    job.gang_consec_failures = 0
+                    job.last_error = ""
         self.assign_once()
         return {"jobs": sorted(self.jobs)}
 
@@ -246,7 +288,13 @@ class JobScheduler:
         """Split active members evenly across running jobs, round-robin by
         sorted index — the reference's 50/50 split generalized to K jobs.
         Each job's dispatch pool repeats a member by its chip weight,
-        interleaved, so shard placement is proportional to capacity."""
+        interleaved, so shard placement is proportional to capacity.
+
+        With a registered mesh group, every running job is instead assigned
+        the WHOLE group: the mesh is one collective serving unit (its
+        backends jit over the global mesh and cannot answer per-member
+        shards), and jobs share it serially through the gang lock."""
+        group = self.mesh_group() if self.mesh_group is not None else None
         members = sorted(self.active_members())
         weights = {m: max(1, int(self.member_weight(m))) for m in members}
         with self._lock:
@@ -256,6 +304,11 @@ class JobScheduler:
                     job.assigned = []
                     job.dispatch_pool = []
             if not running:
+                return
+            if group:
+                for name in running:
+                    self.jobs[name].assigned = sorted(group)
+                    self.jobs[name].dispatch_pool = []
                 return
             for i, name in enumerate(running):
                 job = self.jobs[name]
@@ -280,9 +333,9 @@ class JobScheduler:
             return None
         if not len(job.shard_stats):
             return None
-        # One percentile (one sort), not the full summary — this runs on the
-        # dispatcher threads' idle-poll path under the lock.
-        threshold = self.hedge_factor * job.shard_stats.percentile(50)
+        if job._median_cache is None:
+            job._median_cache = job.shard_stats.percentile(50)
+        threshold = self.hedge_factor * job._median_cache
         now = self.timer()
         for o, ms in sorted(job.outstanding.items()):
             if (
@@ -334,13 +387,141 @@ class JobScheduler:
             job.dispatch_t.setdefault(offset, self.timer())
             return member, offset, shard, excluded
 
+    def _gang_group(self, job: Job):
+        """(group, ok): group is {addr: rank} when the global mesh is fully
+        registered (else None -> per-member dispatch); ok says this job's
+        assignment matches it exactly. While a mesh group is registered,
+        per-member dispatch is NEVER a fallback — the mesh's backends jit
+        over the global mesh and a solo shard would fail on every member
+        (livelock); a mismatched assignment (stale, pre-assign) just waits
+        for the next assignment pass."""
+        if self.mesh_group is None:
+            return None, False
+        group = self.mesh_group()
+        if not group:
+            return None, False
+        return dict(group), set(job.assigned) == set(group)
+
+    def _dispatch_gang(self, job_name: str, group: dict) -> int:
+        """One gang shard: reserve an offset, send the SAME shard to every
+        mesh process (its rank picks its slice), reassemble rank-ordered
+        replies into the shard's predictions, record exactly once. All-or-
+        nothing: any member failing fails the shard, which requeues whole —
+        there is no partial credit for a collective execution."""
+        import concurrent.futures
+
+        job = self.jobs[job_name]
+        with self._lock:
+            if not job.running or not job.assigned:
+                return 0
+            if job.retry_q:
+                offset, _ = job.retry_q.pop(0)
+            elif job.next_offset < len(job.queries):
+                offset = job.next_offset
+                job.next_offset += self.shard_size
+            else:
+                return 0
+            shard = job.queries[offset : offset + self.shard_size]
+            job.outstanding.setdefault(offset, set()).update(group)
+            job.dispatch_t.setdefault(offset, self.timer())
+            if job.first_dispatch_t is None:
+                job.first_dispatch_t = self.timer()
+        synsets = [s for s, _ in shard]
+        world = len(group)
+        t0 = self.timer()
+
+        def call_one(addr: str, rank: int):
+            with tracer.span(
+                "scheduler/dispatch_gang", job=job_name, member=addr, rank=rank, n=len(shard)
+            ):
+                return self.rpc.call(
+                    addr,
+                    "job.predict_gang",
+                    {"model": job.model_name, "synsets": synsets, "rank": rank, "world": world},
+                    timeout=self.shard_timeout_s,
+                )
+
+        # Serialize gangs: concurrent collectives over one mesh deadlock.
+        with self._gang_lock:
+            with concurrent.futures.ThreadPoolExecutor(max_workers=world) as pool:
+                futures = {
+                    rank: pool.submit(call_one, addr, rank)
+                    for addr, rank in sorted(group.items(), key=lambda kv: kv[1])
+                }
+                by_rank: dict[int, list] = {}
+                errors: list[str] = []
+                method_error = False
+                for rank, fut in futures.items():
+                    try:
+                        by_rank[rank] = list(fut.result()["predictions"])
+                    except RpcUnreachable as e:
+                        errors.append(f"rank {rank}: {e}")
+                    except Exception as e:
+                        # The member EXECUTED and refused (rank mismatch,
+                        # batch not divisible, slice > engine cap, ...).
+                        method_error = True
+                        errors.append(f"rank {rank}: {e}")
+
+        def requeue(why: str, breaker: bool) -> int:
+            log.warning("gang shard %s[%d] requeued: %s", job_name, offset, why)
+            with self._lock:
+                job.outstanding.pop(offset, None)
+                job.dispatch_t.pop(offset, None)
+                if offset >= job.finished and offset not in job.buffered:
+                    # Whole-gang retry: no member exclusion — the collective
+                    # needs every process, so exclusions are meaningless.
+                    job.retry_q.append((offset, set()))
+                if breaker:
+                    # Method-level refusals only: a config incompatibility
+                    # (slice > engine batch cap, batch not divisible by
+                    # processes, rank mismatch, ...) fails identically every
+                    # retry, so past the cap the job stops with the error
+                    # surfaced instead of hot-spinning RPCs. Unreachability
+                    # is weather (member restarting) and retries forever —
+                    # the shard timeout already bounds each attempt.
+                    job.gang_consec_failures += 1
+                    if job.gang_consec_failures >= self.gang_max_consec_failures:
+                        job.running = False
+                        job.last_error = f"gang dispatch failing repeatedly: {why}"
+                        log.error("stopping job %s: %s", job_name, job.last_error)
+            return 0
+
+        if errors:
+            return requeue("; ".join(errors), breaker=method_error)
+        preds: list = []
+        for rank in sorted(by_rank):
+            want = gang_slice(len(synsets), rank, world)
+            got = by_rank[rank]
+            if len(got) != want[1] - want[0]:
+                return requeue(
+                    f"rank {rank} returned {len(got)} preds for slice {want}",
+                    breaker=True,
+                )
+            preds.extend(got)
+        elapsed = self.timer() - t0
+        done = self._record_result(job, offset, shard, preds, elapsed)
+        with self._lock:
+            job.gang_consec_failures = 0
+            if done:
+                job.gang_shards += 1
+        return done
+
     def dispatch_once(self, job_name: str) -> int:
         """Send one shard, record its result. Returns the #queries this call
         COMPLETED (0 on failure or duplicate) — an out-of-order success
         buffers its result and still counts as completed work; the contiguous
         ``finished`` cursor advances only when the gap fills. Failures
         requeue the shard with the member excluded — nothing is ever lost or
-        double-counted."""
+        double-counted. A job whose assigned members form the registered
+        mesh group gang-dispatches instead (one collective execution per
+        shard across ALL of them)."""
+        with self._lock:
+            job = self.jobs.get(job_name)
+            group, ok = self._gang_group(job) if job is not None else (None, False)
+        if group is not None:
+            if not ok:
+                return 0  # mesh registered, assignment stale: next assign pass
+            return self._dispatch_gang(job_name, group)
         picked = self.next_shard(job_name)
         if picked is None:
             return 0
@@ -414,6 +595,7 @@ class JobScheduler:
                 job.finished += len(s)
                 job.correct += sum(1 for (_, truth), pred in zip(s, p) if int(pred) == truth)
                 job.shard_stats.record(dt)
+                job._median_cache = None
                 job.query_stats.record_many(dt / max(1, len(s)), len(s))
             if job.done:
                 job.running = False

@@ -44,7 +44,7 @@ class PredictWorker:
         self.backends = dict(backends)
 
     def methods(self) -> dict:
-        return {"job.predict": self._predict}
+        return {"job.predict": self._predict, "job.predict_gang": self._predict_gang}
 
     def _predict(self, p: dict) -> dict:
         model, synsets = p["model"], list(p["synsets"])
@@ -55,6 +55,34 @@ class PredictWorker:
         if len(preds) != len(synsets):
             raise RpcError(f"backend returned {len(preds)} predictions for {len(synsets)} queries")
         return {"predictions": [int(x) for x in preds]}
+
+    def _predict_gang(self, p: dict) -> dict:
+        """Gang-scheduled shard: the leader sent the SAME shard to every
+        process of the global mesh; this process answers only for its rank's
+        contiguous slice, computed inside ONE collective SPMD execution with
+        its peers (InferenceEngine.run_batch_global). The reply carries this
+        rank's predictions; the leader reassembles rank order."""
+        model = p["model"]
+        synsets = list(p["synsets"])
+        rank, world = int(p["rank"]), int(p["world"])
+        backend = self.backends.get(model)
+        if backend is None:
+            raise RpcError(f"model {model!r} not loaded here; have {sorted(self.backends)}")
+        if not hasattr(backend, "predict_gang"):
+            raise RpcError(f"backend for {model!r} cannot serve gang shards")
+        preds = backend.predict_gang(synsets, rank, world)
+        return {"predictions": [int(x) for x in preds]}
+
+
+def gang_slice(n: int, rank: int, world: int) -> tuple[int, int]:
+    """The [start, stop) of rank's contiguous share of an n-query gang
+    shard. Mirrors run_batch_global's row-ownership: the global batch is
+    process 0's rows, then process 1's, ... — so splitting the shard into
+    contiguous per-rank runs keeps reply order == shard order. The leader
+    and every member MUST agree on this function."""
+    share = -(-n // world) if n else 0  # ceil; empty shard -> empty slices
+    start = min(n, rank * share)
+    return start, min(n, start + share)
 
 
 class EngineBackend:
@@ -73,6 +101,9 @@ class EngineBackend:
         data_dir: str | Path,
         batch_size: int = 256,
         image_source=None,
+        mesh=None,
+        variables=None,
+        dtype=None,
     ):
         self.model_name = model_name
         self.data_dir = Path(data_dir)
@@ -80,6 +111,13 @@ class EngineBackend:
         # Optional synsets -> local paths resolver (e.g. an SdfsImageSource
         # for the BASELINE "SDFS shard" config); None = local fixture dirs.
         self.image_source = image_source
+        # Optional engine construction overrides: a GLOBAL (multi-process)
+        # mesh makes this backend gang-capable — predict_gang answers its
+        # rank's slice of a collectively-executed shard. Variables must then
+        # be identical on every process (replicated from SDFS, or same seed).
+        self.mesh = mesh
+        self.variables = variables
+        self.dtype = dtype
         self._engine = None
         self._lock = threading.Lock()
 
@@ -97,7 +135,16 @@ class EngineBackend:
         if self._engine is None:
             from dmlc_tpu.parallel.inference import InferenceEngine
 
-            self._engine = InferenceEngine(self.model_name, batch_size=self.batch_size)
+            kw = {}
+            if self.mesh is not None:
+                kw["mesh"] = self.mesh
+            if self.variables is not None:
+                kw["variables"] = self.variables
+            if self.dtype is not None:
+                kw["dtype"] = self.dtype
+            self._engine = InferenceEngine(
+                self.model_name, batch_size=self.batch_size, **kw
+            )
             self._engine.warmup()
         return self._engine
 
@@ -111,6 +158,36 @@ class EngineBackend:
                 # Multi-batch shard: decode batch i+1 while the device runs
                 # batch i (SURVEY §7 hard part b).
                 result = engine.run_paths_stream(paths)
+            return [int(x) for x in result.top1_index]
+
+    def predict_gang(self, synsets: Sequence[str], rank: int, world: int) -> list[int]:
+        """This rank's slice of a gang shard, through ONE SPMD execution
+        entered by every process of the engine's global mesh. Decodes only
+        the slice's images; an empty slice still enters the collective
+        (every process must, or the others deadlock in it)."""
+        import jax
+        import numpy as np
+
+        from dmlc_tpu.ops import preprocess as pp
+
+        if rank != jax.process_index():
+            # The scheduler's rank map and the jax runtime MUST agree, or
+            # rows come back permuted across members.
+            raise RpcError(
+                f"gang rank mismatch: scheduler says {rank}, "
+                f"jax.process_index() is {jax.process_index()}"
+            )
+        with self._lock:
+            engine = self._ensure_engine()
+            start, stop = gang_slice(len(synsets), rank, world)
+            mine = list(synsets[start:stop])
+            if mine:
+                paths = _resolve_paths(self.image_source, self.data_dir, mine)
+                batch = pp.load_batch(paths, size=engine.input_size)
+            else:
+                s = engine.input_size
+                batch = np.zeros((0, s, s, 3), np.uint8)
+            result = engine.run_batch_global(batch)
             return [int(x) for x in result.top1_index]
 
     def load_variables(self, variables) -> None:

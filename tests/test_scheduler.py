@@ -577,3 +577,178 @@ def test_chip_weighted_placement():
     rep = job.report()
     assert set(rep["member_latency"]) == set(live)
     assert rep["member_latency"]["big"]["count"] == 16
+
+
+# ---------------------------------------------------------------------------
+# gang scheduling over a registered mesh group
+# ---------------------------------------------------------------------------
+
+
+class GangEcho:
+    """Fake gang-capable backend: answers its rank's slice with the class
+    encoded in the synset id, and records every (rank, world, n) call."""
+
+    def __init__(self, log):
+        self.log = log
+
+    def __call__(self, synsets):
+        raise AssertionError("gang job must never take the per-member path")
+
+    def predict_gang(self, synsets, rank, world):
+        from dmlc_tpu.scheduler.worker import gang_slice
+
+        self.log.append((rank, world, len(synsets)))
+        start, stop = gang_slice(len(synsets), rank, world)
+        return [int(s[1:]) for s in synsets[start:stop]]
+
+
+def _gang_fixture(n_queries=40, shard=8):
+    net = SimRpcNetwork()
+    live = ["m0", "m1"]
+    calls = {m: [] for m in live}
+    for m in live:
+        net.serve(m, PredictWorker({"resnet18": GangEcho(calls[m])}).methods())
+    sched = JobScheduler(
+        net.client("L"),
+        lambda: list(live),
+        jobs={"resnet18": make_workload(n_queries)},
+        shard_size=shard,
+        mesh_group=lambda: {"m0": 0, "m1": 1},
+    )
+    sched.is_leading = True
+    net.serve("L", sched.methods())
+    return net, sched, calls
+
+
+def test_gang_dispatch_collective_shards_exactly_once():
+    """A job whose assigned members are exactly the registered mesh group
+    dispatches every shard to ALL of them (one collective execution per
+    shard), reassembles rank-ordered slices, counts each query once, and
+    reports the gang in the jobs report."""
+    net, sched, calls = _gang_fixture(n_queries=40, shard=8)
+    sched._start({})
+    sched.assign_once()
+    sched.run_to_completion()
+    job = sched.jobs["resnet18"]
+    assert job.finished == 40 and job.correct == 40  # slices reassembled in order
+    rep = job.report()
+    assert rep["gang_shards"] == 5  # every shard served collectively
+    # Every shard reached BOTH processes with the full synset list.
+    assert len(calls["m0"]) == 5 and len(calls["m1"]) == 5
+    assert all(c == (0, 2, 8) for c in calls["m0"])
+    assert all(c == (1, 2, 8) for c in calls["m1"])
+
+
+def test_gang_member_failure_requeues_whole_shard():
+    """All-or-nothing: one process failing fails the collective shard; it
+    requeues whole and completes once the fleet is healthy again — no
+    partial credit, no double count."""
+    net, sched, calls = _gang_fixture(n_queries=16, shard=8)
+    sched._start({})
+    sched.assign_once()
+    net.crash("m1")
+    assert sched.dispatch_once("resnet18") == 0  # gang fails, shard requeued
+    assert sched.jobs["resnet18"].retry_q
+    net.restart("m1")
+    sched.run_to_completion()
+    job = sched.jobs["resnet18"]
+    assert job.finished == 16 and job.correct == 16
+    assert job.report()["gang_shards"] == 2  # the retried shard counted once
+
+
+def test_gang_falls_back_to_member_dispatch_while_mesh_unregistered():
+    """mesh_group -> None (mesh not fully registered / not configured):
+    ordinary per-member dispatch through __call__ backends."""
+    net = SimRpcNetwork()
+    live = ["m0", "m1", "m2"]
+    for m in live:
+        net.serve(
+            m,
+            PredictWorker(
+                {"resnet18": lambda synsets: [int(s[1:]) for s in synsets]}
+            ).methods(),
+        )
+    sched = JobScheduler(
+        net.client("L"),
+        lambda: list(live),
+        jobs={"resnet18": make_workload(24)},
+        shard_size=8,
+        mesh_group=lambda: None,  # registration incomplete
+    )
+    sched.is_leading = True
+    sched._start({})
+    sched.assign_once()
+    sched.run_to_completion()
+    job = sched.jobs["resnet18"]
+    assert job.finished == 24 and job.correct == 24
+    assert job.report()["gang_shards"] == 0
+
+
+def test_registered_mesh_group_owns_assignment_and_never_solo_dispatches():
+    """While a mesh group is registered, jobs are assigned the WHOLE group
+    (even with extra non-mesh members active) and shards only ever go out
+    as collectives — a per-member job.predict against a global-mesh backend
+    would fail on every member forever (the round-3 review's livelock)."""
+    net = SimRpcNetwork()
+    live = ["m0", "m1", "m2"]  # m2 active but outside the mesh
+    calls = {m: [] for m in live}
+    for m in live:
+        net.serve(m, PredictWorker({"resnet18": GangEcho(calls[m])}).methods())
+    sched = JobScheduler(
+        net.client("L"),
+        lambda: list(live),
+        jobs={"resnet18": make_workload(24)},
+        shard_size=8,
+        mesh_group=lambda: {"m0": 0, "m1": 1},
+    )
+    sched.is_leading = True
+    sched._start({})
+    # Force a stale assignment (as if assigned before mesh registration):
+    # dispatch must WAIT for the next assign pass, not solo-dispatch
+    # (GangEcho.__call__ raises if the per-member path is ever taken).
+    sched.jobs["resnet18"].assigned = ["m0", "m2"]
+    assert sched.dispatch_once("resnet18") == 0
+    sched.assign_once()
+    assert sched.jobs["resnet18"].assigned == ["m0", "m1"]  # the group, not m2
+    sched.run_to_completion()
+    job = sched.jobs["resnet18"]
+    assert job.finished == 24 and job.correct == 24
+    assert job.report()["gang_shards"] == 3
+    assert calls["m2"] == []
+
+
+def test_gang_config_error_trips_breaker_and_surfaces():
+    """A method-level refusal (config incompatibility) fails identically on
+    every retry: after the cap the job STOPS with the error in the report
+    instead of hot-spinning; `predict` re-arms it. Unreachability (tested
+    in test_gang_member_failure_requeues_whole_shard) never trips it."""
+
+    class Refuses:
+        def __call__(self, synsets):
+            raise AssertionError("per-member path must not be used")
+
+        def predict_gang(self, synsets, rank, world):
+            raise ValueError("batch 64 not divisible by 5 processes")
+
+    net = SimRpcNetwork()
+    live = ["m0", "m1"]
+    for m in live:
+        net.serve(m, PredictWorker({"resnet18": Refuses()}).methods())
+    sched = JobScheduler(
+        net.client("L"),
+        lambda: list(live),
+        jobs={"resnet18": make_workload(16)},
+        shard_size=8,
+        mesh_group=lambda: {"m0": 0, "m1": 1},
+    )
+    sched.is_leading = True
+    sched._start({})
+    for _ in range(sched.gang_max_consec_failures + 2):
+        sched.dispatch_once("resnet18")
+    job = sched.jobs["resnet18"]
+    assert not job.running
+    assert "not divisible" in job.report()["last_error"]
+    assert job.finished == 0
+    # Operator fixes the config and retries: predict re-arms the job.
+    sched._start({})
+    assert job.running and job.report()["last_error"] == ""
