@@ -53,7 +53,10 @@ def start_local_cluster(
 
     Interval constants are the reference's, compressed 5x and multiplied by
     ``scale`` (scale=5 restores the reference's 1 s heartbeat / 3 s loops).
-    ``backends`` is per-node {model: PredictFn} (shared), default the echo
+    ``backends`` is {model: PredictFn} shared by every node, OR a callable
+    ``node_index -> {model: PredictFn}`` for per-node instances (needed
+    when a test must prove EVERY member's backend changed — a shared
+    object would mask a one-member regression); default is the echo
     backend for the configured job models. With ``join`` the fleet is
     joined, converged, and the first leader promoted before returning.
 
@@ -116,7 +119,7 @@ def _start_all(tmp, n_nodes, base, candidates, synset_path, overrides,
         )
         fields.update(overrides)  # caller overrides win over harness defaults
         cfg = ClusterConfig(**fields)
-        node_backends = backends
+        node_backends = backends(i) if callable(backends) else backends
         if node_backends is None:
             node_backends = {name: echo_backend for name in cfg.job_models}
         node = ClusterNode(cfg, backends=node_backends)

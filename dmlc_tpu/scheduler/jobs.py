@@ -150,6 +150,11 @@ class Job:
             "running": self.running,
             "query_samples": self.query_stats.to_wire(),
             "shard_samples": self.shard_stats.to_wire(),
+            # Breaker diagnostics ride along: a failover must not erase WHY
+            # a job was stopped (the surviving leader's report is exactly
+            # where the operator will look).
+            "gang_shards": self.gang_shards,
+            "last_error": self.last_error,
         }
 
     def adopt_wire(self, w: dict) -> None:
@@ -158,6 +163,8 @@ class Job:
         self.running = bool(w["running"])
         self.query_stats = LatencyStats.from_wire(w["query_samples"])
         self.shard_stats = LatencyStats.from_wire(w["shard_samples"])
+        self.gang_shards = int(w.get("gang_shards", 0))
+        self.last_error = str(w.get("last_error", ""))
         self._median_cache = None
         self.reset_inflight()
         # The throughput window is term-local: a new leader measures its own
@@ -221,6 +228,8 @@ class JobScheduler:
         # One gang shard in flight at a time: two concurrent collectives
         # over one mesh would interleave their participants and deadlock.
         self._gang_lock = threading.Lock()
+        self._gang_pool = None  # lazy persistent fan-out pool (not per shard)
+        self._gang_pool_size = 0
         self.gang_max_consec_failures = 8
         self.jobs: dict[str, Job] = {
             name: Job(model_name=name, queries=list(qs)) for name, qs in jobs.items()
@@ -443,24 +452,32 @@ class JobScheduler:
 
         # Serialize gangs: concurrent collectives over one mesh deadlock.
         with self._gang_lock:
-            with concurrent.futures.ThreadPoolExecutor(max_workers=world) as pool:
-                futures = {
-                    rank: pool.submit(call_one, addr, rank)
-                    for addr, rank in sorted(group.items(), key=lambda kv: kv[1])
-                }
-                by_rank: dict[int, list] = {}
-                errors: list[str] = []
-                method_error = False
-                for rank, fut in futures.items():
-                    try:
-                        by_rank[rank] = list(fut.result()["predictions"])
-                    except RpcUnreachable as e:
-                        errors.append(f"rank {rank}: {e}")
-                    except Exception as e:
-                        # The member EXECUTED and refused (rank mismatch,
-                        # batch not divisible, slice > engine cap, ...).
-                        method_error = True
-                        errors.append(f"rank {rank}: {e}")
+            if self._gang_pool is None or self._gang_pool_size < world:
+                old = self._gang_pool
+                self._gang_pool_size = max(world, 8)
+                self._gang_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=self._gang_pool_size, thread_name_prefix="gang"
+                )
+                if old is not None:
+                    old.shutdown(wait=False)
+            pool = self._gang_pool
+            futures = {
+                rank: pool.submit(call_one, addr, rank)
+                for addr, rank in sorted(group.items(), key=lambda kv: kv[1])
+            }
+            by_rank: dict[int, list] = {}
+            errors: list[str] = []
+            method_error = False
+            for rank, fut in futures.items():
+                try:
+                    by_rank[rank] = list(fut.result()["predictions"])
+                except RpcUnreachable as e:
+                    errors.append(f"rank {rank}: {e}")
+                except Exception as e:
+                    # The member EXECUTED and refused (rank mismatch,
+                    # batch not divisible, slice > engine cap, ...).
+                    method_error = True
+                    errors.append(f"rank {rank}: {e}")
 
         def requeue(why: str, breaker: bool) -> int:
             log.warning("gang shard %s[%d] requeued: %s", job_name, offset, why)

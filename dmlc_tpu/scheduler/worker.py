@@ -162,32 +162,51 @@ class EngineBackend:
 
     def predict_gang(self, synsets: Sequence[str], rank: int, world: int) -> list[int]:
         """This rank's slice of a gang shard, through ONE SPMD execution
-        entered by every process of the engine's global mesh. Decodes only
-        the slice's images; an empty slice still enters the collective
-        (every process must, or the others deadlock in it)."""
+        entered by every process of the engine's global mesh.
+
+        Failure symmetry is the load-bearing property: every process must
+        enter the collective or the others deadlock inside it holding this
+        backend's lock. So any per-rank failure that the OTHER ranks cannot
+        see (an unreadable corpus file, a rank mismatch, an over-cap slice
+        on just the non-tail ranks) is deferred — this rank still enters
+        the collective with an EMPTY batch, then raises to the leader after
+        its peers have been released. Only failures that hit every rank
+        identically (engine construction, batch/process divisibility) may
+        raise before the collective."""
         import jax
         import numpy as np
 
         from dmlc_tpu.ops import preprocess as pp
 
-        if rank != jax.process_index():
-            # The scheduler's rank map and the jax runtime MUST agree, or
-            # rows come back permuted across members.
-            raise RpcError(
-                f"gang rank mismatch: scheduler says {rank}, "
-                f"jax.process_index() is {jax.process_index()}"
-            )
         with self._lock:
             engine = self._ensure_engine()
-            start, stop = gang_slice(len(synsets), rank, world)
-            mine = list(synsets[start:stop])
-            if mine:
-                paths = _resolve_paths(self.image_source, self.data_dir, mine)
-                batch = pp.load_batch(paths, size=engine.input_size)
-            else:
-                s = engine.input_size
-                batch = np.zeros((0, s, s, 3), np.uint8)
+            size = engine.input_size
+            deferred: Exception | None = None
+            batch = np.zeros((0, size, size, 3), np.uint8)
+            try:
+                if rank != jax.process_index():
+                    # Scheduler rank map and jax runtime MUST agree, or
+                    # rows come back permuted across members.
+                    raise RpcError(
+                        f"gang rank mismatch: scheduler says {rank}, "
+                        f"jax.process_index() is {jax.process_index()}"
+                    )
+                start, stop = gang_slice(len(synsets), rank, world)
+                mine = list(synsets[start:stop])
+                cap = engine.batch_size // max(1, jax.process_count())
+                if len(mine) > cap:
+                    raise RpcError(
+                        f"gang slice of {len(mine)} exceeds per-process "
+                        f"batch cap {cap} (shard too large for the engines)"
+                    )
+                if mine:
+                    paths = _resolve_paths(self.image_source, self.data_dir, mine)
+                    batch = pp.load_batch(paths, size=size)
+            except Exception as e:
+                deferred = e
             result = engine.run_batch_global(batch)
+            if deferred is not None:
+                raise RpcError(f"{type(deferred).__name__}: {deferred}")
             return [int(x) for x in result.top1_index]
 
     def load_variables(self, variables) -> None:

@@ -85,3 +85,104 @@ def test_import_tool_requires_destination(tmp_path, capsys):
     np.savez(ckpt, a=np.ones(1))
     with pytest.raises(SystemExit):
         tool.main(["resnet18", str(ckpt)])  # neither --leader nor --out
+
+
+def test_checkpoint_to_live_accuracy_end_to_end(tmp_path, capsys):
+    """VERDICT r2 item 7: the full operator path from a real (torch-layout)
+    checkpoint ON DISK to LIVE accuracy — import tool converts + publishes,
+    `train` hot-swaps every member's engine, and the cluster's predictions
+    and jobs-report accuracy are EXACTLY what that checkpoint computes on
+    the fixture corpus (ground truth: the torch model itself, f32)."""
+    import jax.numpy as jnp
+    import torch.nn.functional  # noqa: F401  (TorchResNet18 deps)
+    from test_model_parity import TorchResNet18
+
+    from dmlc_tpu.cluster.localcluster import (
+        start_local_cluster,
+        stop_local_cluster,
+        wait_until,
+    )
+    from dmlc_tpu.ops import preprocess as pp
+    from dmlc_tpu.scheduler.worker import EngineBackend
+    from dmlc_tpu.utils import corpus
+
+    # A REAL torch.save checkpoint in the torchvision layout. The head is
+    # sharpened (x10) so top-1 margins dwarf any float reordering between
+    # the torch reference and the XLA engine.
+    torch.manual_seed(11)
+    tmodel = TorchResNet18(num_classes=1000).eval()
+    sd = tmodel.state_dict()
+    sd["fc.weight"] = sd["fc.weight"] * 10.0
+    sd["fc.bias"] = sd["fc.bias"] * 10.0
+    tmodel.load_state_dict(sd)
+    ckpt = tmp_path / "resnet18.pth"
+    torch.save(sd, ckpt)
+
+    n_classes = 6
+    data_dir, synset_path = corpus.generate(
+        tmp_path / "corpus", n_classes=n_classes, images_per_class=1, size=64
+    )
+    synsets = [line.split()[0] for line in synset_path.read_text().splitlines()]
+    paths = [pp.class_image_path(data_dir, s) for s in synsets]
+
+    # Ground truth: the checkpoint's own predictions (torch, f32, same
+    # decode + normalize the engines use).
+    batch = pp.load_batch(paths, size=224)
+    mean, std = pp.stats_for_model("resnet18")
+    x = (batch.astype(np.float32) / 255.0 - mean) / std
+    with torch.no_grad():
+        logits = tmodel(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+    expected = logits.argmax(-1).numpy().tolist()
+    expected_acc = float(np.mean([p == i for i, p in enumerate(expected)]))
+
+    # Per-node backends (factory): each member must hot-swap its OWN
+    # engine — a shared instance would mask a broadcast-reaches-one bug.
+    backends = [
+        {"resnet18": EngineBackend("resnet18", data_dir, batch_size=8, dtype=jnp.float32)}
+        for _ in range(2)
+    ]
+    nodes = start_local_cluster(
+        tmp_path / "fleet",
+        n_nodes=2,
+        backends=lambda i: backends[i],
+        synset_path=synset_path,
+        data_dir=str(data_dir),
+        job_models=["resnet18"],
+        batch_size=8,
+        dispatch_shard_size=8,
+    )
+    try:
+        # 1. Import + publish through the operator tool (real TCP).
+        tool = _load_tool()
+        assert tool.main(["resnet18", str(ckpt), "--leader", nodes[0].self_leader_addr]) == 0
+        assert "published v1" in capsys.readouterr().out
+
+        # 2. `train` broadcasts the blob and hot-swaps live engines.
+        results = nodes[1].train()
+        assert sorted(results["models/resnet18"]["loaded"]) == sorted(
+            n.self_member_addr for n in nodes
+        )
+
+        # 3. Row-for-row, on EVERY member's own engine: each predict shard
+        # returns exactly the checkpoint's own predictions.
+        for node in nodes:
+            reply = nodes[0].rpc.call(
+                node.self_member_addr,
+                "job.predict",
+                {"model": "resnet18", "synsets": synsets},
+                timeout=300.0,
+            )
+            assert reply["predictions"] == expected, node.self_member_addr
+
+        # 4. The jobs report's accuracy is exactly the checkpoint's.
+        nodes[1].predict()
+        wait_until(
+            lambda: all(j.done for j in nodes[0].scheduler.jobs.values()),
+            timeout=120.0,
+            msg="job completion",
+        )
+        report = nodes[1].jobs_report()["resnet18"]
+        assert report["finished"] == n_classes
+        assert abs(report["accuracy"] - expected_acc) < 1e-9
+    finally:
+        stop_local_cluster(nodes)
