@@ -38,6 +38,7 @@ import argparse
 import json
 import sys
 import time
+from functools import partial
 from pathlib import Path
 
 import numpy as np
@@ -186,6 +187,115 @@ def bench_flash() -> dict:
             "dense_ms": round(td, 2),
             "dense_over_flash": round(td / tf, 3),
         }
+    return out
+
+
+def bench_train() -> dict:
+    """TRAINING throughput — capability the reference has none of
+    (SURVEY §5: no training anywhere). Two configs, both reported with the
+    chip count and per-chip rates like the serving numbers:
+
+    - vit_b16 supervised: the full SPMD train step (parallel/train.py,
+      donated state) dp-sharded over every local chip, img/s.
+    - causal LM, schedule="flash": an 8-layer SPTransformerLM at S=2048
+      training THROUGH the Pallas flash-attention forward+backward kernels
+      (ops/pallas_kernels.py), tokens/s + a 6ND MFU estimate.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dmlc_tpu.models import get_model
+    from dmlc_tpu.parallel import mesh as mesh_lib
+    from dmlc_tpu.parallel import train as train_lib
+    from dmlc_tpu.parallel.sp_transformer import SPTransformerLM
+
+    out = {}
+    platform = jax.devices()[0].platform
+    peak = _PEAK_FLOPS.get(platform, _PEAK_FLOPS["cpu"])
+
+    # --- ViT-B/16 supervised train step -------------------------------
+    B = 128
+    spec = get_model("vit_b16")
+    model = spec.module(dtype=jnp.bfloat16)
+    _, variables = spec.init_params(jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    mesh = mesh_lib.make_mesh({"dp": jax.device_count()})
+    state = train_lib.create_train_state(
+        model, variables, train_lib.default_optimizer(1e-3)
+    )
+    state, step_fn = train_lib.make_train_step(mesh, state)
+    images = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (B, 224, 224, 3), jnp.bfloat16)
+    )
+    labels = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(2), (B,), 0, 1000, jnp.int32)
+    )
+    state, metrics = step_fn(state, images, labels)
+    np.asarray(metrics["loss"])  # true barrier (compile + first step)
+    iters = 15
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step_fn(state, images, labels)
+    np.asarray(metrics["loss"])
+    dt = (time.perf_counter() - t0) / iters
+    n_chips = jax.device_count()
+    out["vit_b16_train"] = {
+        "batch": B,
+        "chips": n_chips,
+        "images_per_sec": round(B / dt, 1),
+        "images_per_sec_per_chip": round(B / dt / max(1, n_chips), 1),
+        "step_ms": round(dt * 1e3, 1),
+    }
+
+    # --- causal LM with flash-attention schedule -----------------------
+    Bl, S = 8, 2048
+    lm = SPTransformerLM(
+        vocab=32768, num_layers=8, num_heads=12, hidden=768, mlp_dim=3072,
+        max_len=S, schedule="flash", dtype=jnp.bfloat16,
+    )
+    # S+1 raw tokens: the shifted input/target slices are then exactly S
+    # long (an odd length like 2047 has no Mosaic-legal flash block and
+    # would be rejected with advice to pad).
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(3), (Bl, S + 1), 0, 32768, jnp.int32)
+    )
+    params = lm.init(jax.random.PRNGKey(4), tokens[:, :-1])
+    n_params = sum(int(np.prod(np.shape(p))) for p in jax.tree_util.tree_leaves(params))
+    opt = optax.adamw(3e-4)
+    opt_state = opt.init(params)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def lm_step(params, opt_state, tokens):
+        def loss(p):
+            logits = lm.apply(p, tokens[:, :-1])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), tokens[:, 1:]
+            ).mean()
+
+        l, g = jax.value_and_grad(loss)(params)
+        upd, opt_state2 = opt.update(g, opt_state, params)
+        return optax.apply_updates(params, upd), opt_state2, l
+
+    params, opt_state, l = lm_step(params, opt_state, tokens)
+    np.asarray(l)
+    iters = 15
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, l = lm_step(params, opt_state, tokens)
+    np.asarray(l)
+    dt = (time.perf_counter() - t0) / iters
+    tok_s = Bl * S / dt
+    mfu = 6.0 * n_params * tok_s / peak  # 6ND, attention flops excluded
+    out["lm_flash_train"] = {
+        "batch": Bl,
+        "seq": S,
+        "chips": n_chips,
+        "params_m": round(n_params / 1e6, 1),
+        "tokens_per_sec": round(tok_s, 0),
+        "tokens_per_sec_per_chip": round(tok_s / max(1, n_chips), 0),
+        "step_ms": round(dt * 1e3, 1),
+        "mfu_6nd": round(mfu, 4),  # per-fleet; divide by chips for per-chip
+    }
     return out
 
 
@@ -451,9 +561,34 @@ def main() -> None:
             )
             print(f"[bench-curve] {model} img/s/chip by batch: {line}", file=sys.stderr)
 
+    # Training throughput (beyond the reference entirely): last because the
+    # serving numbers above are the BASELINE contract; budget-gated like
+    # every extra.
+    train = {}
+    if not over_budget("train"):
+        try:
+            train = bench_train()
+            for key, r in train.items():
+                rate = r.get("images_per_sec") or r.get("tokens_per_sec")
+                unit = "img/s" if "images_per_sec" in r else "tok/s"
+                extra = f" mfu_6nd={r['mfu_6nd']}" if "mfu_6nd" in r else ""
+                print(
+                    f"[bench-train] {key}: {rate} {unit} "
+                    f"step={r['step_ms']}ms{extra}",
+                    file=sys.stderr,
+                )
+        except Exception as e:
+            print(f"[bench-train] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+
     Path("bench_detail.json").write_text(
         json.dumps(
-            {"configs": results, "e2e": e2e, "batch_curve": curve, "flash": flash},
+            {
+                "configs": results,
+                "e2e": e2e,
+                "batch_curve": curve,
+                "flash": flash,
+                "train": train,
+            },
             indent=2,
         )
     )

@@ -105,14 +105,35 @@ def _softmax_top1_kernel(logits_ref, idx_ref, prob_ref):
 _RESIDENT_KV_BYTES = 4 * 1024 * 1024
 
 
+# Longest sequence allowed to run as ONE full-S block (the fallback for
+# odd/prime S with no Mosaic-legal sub-block, and for explicit blk >= S):
+# the kernel materializes a [blk_q, blk_k] f32 score tile in VMEM, so a
+# full-S block costs S^2 * 4 bytes — 4 MiB at 1024, which together with
+# the resident operands still fits a ~16 MiB VMEM core. Past this, pad the
+# sequence to a multiple of 8 instead.
+_FULL_BLOCK_CAP = 1024
+
+
 def _auto_block(s: int, requested: int | None, default: int) -> int:
-    """Largest divisor of ``s`` not exceeding the requested block size —
-    S=192 with 128-blocks runs at blk=64 instead of failing."""
+    """Largest Mosaic-LEGAL block for a sequence of length ``s``: a divisor
+    of s that is also a multiple of 8 (the TPU lowering requires block dims
+    divisible by 8 unless equal to the array dim), not exceeding the
+    requested size — S=192 with 128-blocks runs at blk=64. Sequences with
+    no such divisor (odd S, primes) fall back to ONE full-S block — always
+    layout-legal, but its [S, S] score tile must fit VMEM, hence capped at
+    _FULL_BLOCK_CAP."""
     blk = min(requested if requested is not None else default, s)
-    for d in range(blk, 0, -1):
-        if s % d == 0:
-            return d
-    return 1
+    if blk < s:
+        for d in range(blk - blk % 8, 7, -8):
+            if s % d == 0:
+                return d
+    if s <= _FULL_BLOCK_CAP:
+        return s
+    raise ValueError(
+        f"sequence {s} has no block divisor that is a multiple of 8 and is "
+        f"too long for a single full-sequence block (> {_FULL_BLOCK_CAP}): "
+        "pad the sequence"
+    )
 
 
 def _flash_kernel(
@@ -368,10 +389,10 @@ def flash_attention(q, k, v, *, causal: bool = False, scale: float | None = None
     compile ceiling is gone; bigger default q blocks keep the streamed
     matmuls MXU-bound).
 
-    Block sizes default per schedule and are shrunk to the largest divisor
-    of S, so any S with a factor >= 8 runs; genuinely pathological lengths
-    (e.g. prime S) are rejected rather than silently degraded to tiny
-    blocks — pad the sequence instead.
+    Block sizes default per schedule and are shrunk to the largest
+    Mosaic-legal divisor of S (a multiple of 8); lengths with no such
+    divisor (odd S, primes) run as one full-S block up to
+    _FULL_BLOCK_CAP and are rejected past it — pad the sequence instead.
 
     Differentiable with O(S) memory end-to-end: the forward saves only the
     per-row log-sum-exp, and the backward recomputes p blockwise in two
@@ -386,11 +407,6 @@ def flash_attention(q, k, v, *, causal: bool = False, scale: float | None = None
     # per byte, and 256 keeps the MXU (not HBM) the bottleneck.
     bq = _auto_block(s, blk_q, 128 if resident else 256)
     bk = _auto_block(s, blk_k, 128 if resident else 256)
-    if min(bq, bk) < 8 and s > 8:
-        raise ValueError(
-            f"sequence {s} has no usable block divisor (largest <= requested is "
-            f"{min(bq, bk)}): pad the sequence or pass explicit blk_q/blk_k"
-        )
     if scale is None:
         scale = dh**-0.5
     return _flash(causal, float(scale), bq, bk, q, k, v)

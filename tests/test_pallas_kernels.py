@@ -103,13 +103,43 @@ def test_flash_attention_divisor_blocks():
     np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)
 
 
-def test_flash_attention_rejects_pathological_sequence():
+def test_flash_attention_odd_sequence_full_block():
+    # No 8-divisible divisor (prime S): falls back to ONE full-S block,
+    # which Mosaic always accepts (block dim == array dim).
+    from dmlc_tpu.ops.pallas_kernels import flash_attention
+    from dmlc_tpu.parallel.ring_attention import dense_attention
+
+    q, k, v = _qkv(4, s=193, d=16)
+    want = np.asarray(dense_attention(q, k, v, causal=True))
+    got = np.asarray(flash_attention(q, k, v, causal=True))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)
+
+
+def test_flash_attention_odd_sequence_grads_match_dense():
+    # The full-block fallback must be training-grade too: backward with
+    # n_q = n_k = 1 (no scratch carries) at an odd S.
+    from dmlc_tpu.ops.pallas_kernels import flash_attention
+    from dmlc_tpu.parallel.ring_attention import dense_attention
+
+    q, k, v = _qkv(8, b=1, h=2, s=193, d=16)
+
+    def loss(att, q, k, v):
+        return jnp.sum(att(q, k, v, causal=True) ** 2)
+
+    want = jax.grad(lambda q, k, v: loss(dense_attention, q, k, v), argnums=(0, 1, 2))(q, k, v)
+    got = jax.grad(lambda q, k, v: loss(flash_attention, q, k, v), argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=5e-5, rtol=1e-4)
+
+
+def test_flash_attention_rejects_long_unpaddable_sequence():
     import pytest
 
     from dmlc_tpu.ops.pallas_kernels import flash_attention
 
-    q, k, v = _qkv(4, s=193, d=16)  # prime: largest usable divisor is 1
-    with pytest.raises(ValueError, match="block divisor"):
+    # Odd AND past the full-block VMEM cap: refuse with advice to pad.
+    q, k, v = _qkv(4, b=1, h=1, s=8209, d=16)  # prime > _FULL_BLOCK_CAP
+    with pytest.raises(ValueError, match="pad the sequence"):
         flash_attention(q, k, v)
 
 
