@@ -74,17 +74,225 @@ def _flops_per_image(engine) -> float | None:
         return None
 
 
+def _time_left(deadline: float | None) -> float:
+    """Seconds until a ``time.monotonic()`` deadline; +inf when uncapped.
+    The single definition of deadline semantics for every bench section."""
+    return float("inf") if deadline is None else deadline - time.monotonic()
+
+
+def degraded_vs_best(r: dict, history_best: dict) -> bool:
+    """True when a measurement is >3x off the best this (model, batch) has
+    ever recorded — the signature of a degraded tunnel window (round 3: every
+    model landed at ~1/20th of its known rate and the artifact recorded the
+    garbage with no annotation), not of ordinary ±5-10% wobble."""
+    best = history_best.get(f"{r.get('model')}@{r.get('batch_size')}")
+    if not best:
+        return False
+    slow_lat = (
+        bool(r.get("p50_ms"))
+        and bool(best.get("p50_ms"))
+        and r["p50_ms"] > 3.0 * best["p50_ms"]
+    )
+    ips = r.get("images_per_sec_per_chip") or 0.0
+    slow_thr = (
+        bool(best.get("images_per_sec_per_chip"))
+        and ips < best["images_per_sec_per_chip"] / 3.0
+    )
+    return slow_lat or slow_thr
+
+
+def update_history_best(history_best: dict, results: list[dict]) -> dict:
+    """Fold this run's configs into the per-(model,batch) best-known record.
+    Degraded-window measurements never improve the record, so a later healthy
+    run is still compared against the true chip-side numbers."""
+    out = dict(history_best)
+    for r in results:
+        ips = r.get("images_per_sec_per_chip")
+        # A flagged row never touches the record even if its throughput
+        # still beats it: a latency-degraded window would otherwise fold a
+        # 3x-inflated p50 into the baseline and weaken the latency guard.
+        if not ips or r.get("degraded_vs_history"):
+            continue
+        key = f"{r['model']}@{r['batch_size']}"
+        cur = out.get(key)
+        if cur is None or ips > (cur.get("images_per_sec_per_chip") or 0.0):
+            # A curve-sweep best (no latency loop) must not erase the p50
+            # reference the latency-degradation check needs.
+            p50 = r.get("p50_ms")
+            if p50 is None and cur:
+                p50 = cur.get("p50_ms")
+            out[key] = {"images_per_sec_per_chip": ips, "p50_ms": p50}
+    return out
+
+
+def merge_detail(new: dict, old: dict) -> dict:
+    """Merge this run's sections over the previous artifact.
+
+    A section this run skipped or failed KEEPS the previous run's data,
+    stamped ``"stale": true``, instead of being overwritten with ``{}`` /
+    ``null`` — round 3's bench destroyed its own committed artifact that way
+    while README/PARITY still cited the numbers (VERDICT r3, weak #2/#3).
+    """
+    out: dict = {}
+    for key in ("captured_at", "degraded_tunnel"):
+        if new.get(key) is not None:
+            out[key] = new[key]
+
+    # Configs key by (model, batch) like history_best: a --batch-size 256
+    # fallback run must not erase the committed batch-1024 headline row.
+    # Like curve points below, a degraded-window row never replaces a
+    # healthy committed row — the garbage number is preserved in the
+    # driver's BENCH_r*.json, not in the artifact README/PARITY cite.
+    new_configs = new.get("configs") or []
+    old_by_key = {
+        (r.get("model"), r.get("batch_size")): r for r in old.get("configs") or []
+    }
+    merged_cfg = []
+    seen = set()
+    for r in new_configs:
+        key = (r.get("model"), r.get("batch_size"))
+        prev = old_by_key.get(key)
+        if (
+            r.get("degraded_vs_history")
+            and prev is not None
+            and not prev.get("degraded_vs_history")
+        ):
+            continue
+        seen.add(key)
+        merged_cfg.append(r)
+    for key, r in old_by_key.items():
+        if key not in seen:
+            merged_cfg.append(dict(r, stale=True))
+    out["configs"] = merged_cfg
+
+    # Curve: per-point merge; a degraded-window point never replaces a
+    # healthy committed point (it would poison the data batch_overrides is
+    # justified by). Fresh healthy points also feed history_best below.
+    curve: dict = {}
+    curve_fresh: list[dict] = []
+    new_curve = new.get("batch_curve") or {}
+    old_curve = old.get("batch_curve") or {}
+    for m in set(new_curve) | set(old_curve):
+        pts = {p["batch_size"]: dict(p, stale=True) for p in old_curve.get(m, [])}
+        for p in new_curve.get(m, []):
+            prev = pts.get(p["batch_size"])
+            if (
+                p.get("degraded_vs_history")
+                and prev is not None
+                and not prev.get("degraded_vs_history")
+            ):
+                continue
+            pts[p["batch_size"]] = p
+            if not p.get("degraded_vs_history"):
+                curve_fresh.append(
+                    {
+                        "model": m,
+                        "batch_size": p["batch_size"],
+                        "images_per_sec_per_chip": p.get("images_per_sec_per_chip"),
+                    }
+                )
+        curve[m] = [pts[b] for b in sorted(pts)]
+    out["batch_curve"] = curve
+
+    # e2e: flat section — new non-None fields win; fields a deadline
+    # truncated (None) fall back to the previous run's values, and the mix
+    # is stamped stale so the section self-documents. Fields only fall back
+    # within the SAME model: a promoted-headline run's gaps must not be
+    # filled with another model's rates.
+    new_e2e, old_e2e = new.get("e2e"), old.get("e2e")
+    if new_e2e and old_e2e and new_e2e.get("model") != old_e2e.get("model"):
+        if any(v is None for v in new_e2e.values()):
+            new_e2e = None  # partial for a different model: keep old whole
+        else:
+            old_e2e = None  # complete new section replaces old outright
+    if new_e2e and old_e2e:
+        merged = {k: v for k, v in old_e2e.items() if k != "stale"}
+        fell_back = False
+        for k, v in new_e2e.items():
+            if v is None and merged.get(k) is not None:
+                fell_back = True
+            else:
+                merged[k] = v
+        if fell_back:
+            merged["stale"] = True
+        out["e2e"] = merged
+    elif new_e2e or old_e2e:
+        out["e2e"] = new_e2e if new_e2e else dict(old_e2e, stale=True)
+    else:
+        out["e2e"] = new_e2e
+
+    # flash/train: dict-of-entry sections — merge per entry so a truncated
+    # run (e.g. train that only reached vit_b16_train) keeps the previous
+    # lm_flash_train instead of deleting it; staleness is stamped INSIDE
+    # each kept entry, never at section level where consumers iterate.
+    for key in ("flash", "train"):
+        new_sec = {k: v for k, v in (new.get(key) or {}).items() if isinstance(v, dict)}
+        old_sec = {k: v for k, v in (old.get(key) or {}).items() if isinstance(v, dict)}
+        merged = {k: dict(v, stale=True) for k, v in old_sec.items()}
+        merged.update(new_sec)
+        out[key] = merged if merged else (new.get(key) or {})
+
+    out["history_best"] = update_history_best(
+        old.get("history_best") or {}, list(new_configs) + curve_fresh
+    )
+    return out
+
+
+def load_prev_detail(path: str = "bench_detail.json") -> dict:
+    """Load the previous artifact. A file that EXISTS but fails to parse is
+    moved aside (``<path>.corrupt``) with a stderr warning rather than being
+    silently treated as absent — a truncated write would otherwise disable
+    every degradation guard and let the next merge erase all history."""
+    p = Path(path)
+    if not p.exists():
+        return {}
+    try:
+        data = json.loads(p.read_text())
+        if not isinstance(data, dict):
+            raise ValueError(f"artifact is {type(data).__name__}, expected object")
+        return data
+    except Exception as e:
+        corrupt = p.with_suffix(p.suffix + ".corrupt")
+        try:
+            p.rename(corrupt)
+        except OSError:
+            corrupt = p
+        print(
+            f"[bench] WARNING: {path} unparseable ({type(e).__name__}: {e}); "
+            f"preserved at {corrupt} — degradation history unavailable this run",
+            file=sys.stderr,
+        )
+        return {}
+
+
 def bench_model(
     model: str,
     batch_size: int,
     seconds: float = 4.0,
     passes: int = 2,
     latency_iters: int = 15,
+    deadline: float | None = None,
+    max_passes: int = 4,
+    agree_rtol: float = 0.10,
 ) -> dict:
+    """One config's steady-state throughput + sync latency.
+
+    ``deadline`` (a ``time.monotonic()`` stamp) hard-caps this config's wall
+    clock: the iteration count shrinks to fit, extra passes stop, and the
+    latency loop exits early — so one degraded-tunnel window costs bounded
+    time instead of eating the whole bench budget (round-3 post-mortem: four
+    configs took 496 s because nothing inside a config checked the clock).
+    Passes escalate beyond ``passes`` (up to ``max_passes``) until the best
+    two agree within ``agree_rtol`` — best-of-2 absorbs ±5% wobble, not a
+    mid-run degradation step.
+    """
     import jax
 
     from dmlc_tpu.parallel.inference import InferenceEngine
     from dmlc_tpu.utils.metrics import LatencyStats
+
+    def time_left() -> float:
+        return _time_left(deadline)
 
     engine = InferenceEngine(model, batch_size=batch_size, use_pallas=False)
     compile_s = engine.warmup()
@@ -104,28 +312,70 @@ def bench_model(
     jax.block_until_ready(bufs)
 
     # Calibrate iteration count to ~`seconds` of steady state, min 10 batches.
+    # This sync round trip doubles as the first latency sample, so even a
+    # deadline-truncated run reports a real p50.
     t0 = time.perf_counter()
     jax.block_until_ready(engine._forward(engine.variables, bufs[0]))
     per_batch = time.perf_counter() - t0
     iters = max(10, min(200, int(seconds / max(per_batch, 1e-4))))
+    if deadline is not None:
+        # Fit at least `passes` throughput passes plus a short latency loop
+        # into the remaining wall clock; min 3 keeps the measurement real.
+        cap = int(time_left() * 0.7 / max(passes, 1) / max(per_batch, 1e-4))
+        iters = max(3, min(iters, cap))
 
     # Throughput: async dispatch of every batch, one sync at the end — the
     # device queue stays full, tunnel RTT amortizes across the whole run.
-    # Best of two passes: the remote tunnel's throughput wobbles run to run,
+    # Best of N passes: the remote tunnel's throughput wobbles run to run,
     # and the chip-side rate is the max, not the mean.
-    elapsed = float("inf")
-    for _ in range(max(1, passes)):
+    def one_pass() -> float:
+        """One throughput pass, pipelined in chunks so the clock is checked
+        mid-pass WITHOUT draining the device queue: the next chunk is always
+        dispatched before the previous one is synced, so the device never
+        idles — but a tunnel that degrades 20x mid-pass (round-3 weather)
+        costs ~2 chunks, not one 17-minute block_until_ready on the whole
+        pass. Returns the elapsed time normalized to `iters` batches."""
+        chunk = max(1, iters // 8)
         t_start = time.perf_counter()
-        outs = [engine._forward(engine.variables, bufs[i % n_bufs]) for i in range(iters)]
-        jax.block_until_ready(outs)
-        elapsed = min(elapsed, time.perf_counter() - t_start)
+        prev: list | None = None
+        done = 0
+        for s in range(0, iters, chunk):
+            cur = [
+                engine._forward(engine.variables, bufs[i % n_bufs])
+                for i in range(s, min(s + chunk, iters))
+            ]
+            done = s + len(cur)
+            if prev is not None:
+                jax.block_until_ready(prev)
+                if time_left() < 0:
+                    break
+            prev = cur
+        jax.block_until_ready(cur)
+        return (time.perf_counter() - t_start) * iters / done
 
-    # Latency: synced per-batch round trips, measured separately.
-    stats = LatencyStats()
-    for i in range(min(iters, latency_iters)):
+    elapsed_list: list[float] = []
+    for p in range(max(1, passes, max_passes)):
+        if p >= 1:
+            srt = sorted(elapsed_list)
+            agreed = len(srt) >= 2 and (srt[1] - srt[0]) <= agree_rtol * srt[0]
+            if p >= passes and agreed:
+                break
+            if time_left() < srt[0] * 1.25:
+                break
+        elapsed_list.append(one_pass())
+    elapsed = min(elapsed_list)
+
+    # Latency: synced per-batch round trips, measured separately; seeded by
+    # the calibration round trip and deadline-gated per iteration.
+    stats = LatencyStats([per_batch])
+    per_rt = per_batch
+    for i in range(max(0, min(iters, latency_iters) - 1)):
+        if time_left() < per_rt * 1.5:
+            break
         tb = time.perf_counter()
         jax.block_until_ready(engine._forward(engine.variables, bufs[i % n_bufs]))
-        stats.record(time.perf_counter() - tb)
+        per_rt = time.perf_counter() - tb
+        stats.record(per_rt)
 
     n_chips = jax.device_count()
     platform = jax.devices()[0].platform
@@ -145,6 +395,7 @@ def bench_model(
         "batch_size": batch_size,
         "compile_s": round(compile_s, 2),
         "iters": iters,
+        "passes": len(elapsed_list),
         "images_per_sec": round(images_per_sec, 1),
         "images_per_sec_per_chip": round(per_chip, 1),
         "p50_ms": round(summary["median"] * 1e3, 2),
@@ -154,7 +405,7 @@ def bench_model(
     }
 
 
-def bench_flash() -> dict:
+def bench_flash(deadline: float | None = None) -> dict:
     """Flash vs XLA-dense attention (bf16, Dh=128, causal) at the kernel's
     two regimes: VMEM-resident K/V (S=2048) and near the resident ceiling
     (S=8192). Returns per-config ms and the dense/flash speed ratio."""
@@ -164,10 +415,15 @@ def bench_flash() -> dict:
     from dmlc_tpu.ops.pallas_kernels import flash_attention
     from dmlc_tpu.parallel.ring_attention import dense_attention
 
+    def time_left() -> float:
+        return _time_left(deadline)
+
     def timed(fn, args, iters=20):
         np.asarray(fn(*args)[0, 0, 0, :2])  # compile + true barrier
         best = float("inf")
         for _ in range(3):
+            if best < float("inf") and time_left() < best * iters * 1.25:
+                break
             t0 = time.perf_counter()
             outs = [fn(*args) for _ in range(iters)]
             np.asarray(outs[-1][0, 0, 0, :2])
@@ -176,6 +432,8 @@ def bench_flash() -> dict:
 
     out = {}
     for s, h in ((2048, 8), (8192, 2)):
+        if out and time_left() <= 0:
+            break
         ks = jax.random.split(jax.random.PRNGKey(0), 3)
         q, k, v = (jax.random.normal(x, (1, h, s, 128), jnp.bfloat16) for x in ks)
         np.asarray(q[0, 0, 0, :2])
@@ -190,7 +448,7 @@ def bench_flash() -> dict:
     return out
 
 
-def bench_train() -> dict:
+def bench_train(deadline: float | None = None) -> dict:
     """TRAINING throughput — capability the reference has none of
     (SURVEY §5: no training anywhere). Two configs, both reported with the
     chip count and per-chip rates like the serving numbers:
@@ -214,6 +472,14 @@ def bench_train() -> dict:
     platform = jax.devices()[0].platform
     peak = _PEAK_FLOPS.get(platform, _PEAK_FLOPS["cpu"])
 
+    def time_left() -> float:
+        return _time_left(deadline)
+
+    def capped_iters(per_step: float, want: int = 15) -> int:
+        if deadline is None:
+            return want
+        return max(3, min(want, int(time_left() * 0.8 / max(per_step, 1e-4))))
+
     # --- ViT-B/16 supervised train step -------------------------------
     B = 128
     spec = get_model("vit_b16")
@@ -232,7 +498,11 @@ def bench_train() -> dict:
     )
     state, metrics = step_fn(state, images, labels)
     np.asarray(metrics["loss"])  # true barrier (compile + first step)
-    iters = 15
+    t0 = time.perf_counter()
+    state, metrics = step_fn(state, images, labels)
+    np.asarray(metrics["loss"])
+    per_step = time.perf_counter() - t0
+    iters = capped_iters(per_step)
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = step_fn(state, images, labels)
@@ -248,6 +518,8 @@ def bench_train() -> dict:
     }
 
     # --- causal LM with flash-attention schedule -----------------------
+    if time_left() <= 0:
+        return out
     Bl, S = 8, 2048
     lm = SPTransformerLM(
         vocab=32768, num_layers=8, num_heads=12, hidden=768, mlp_dim=3072,
@@ -278,7 +550,11 @@ def bench_train() -> dict:
 
     params, opt_state, l = lm_step(params, opt_state, tokens)
     np.asarray(l)
-    iters = 15
+    t0 = time.perf_counter()
+    params, opt_state, l = lm_step(params, opt_state, tokens)
+    np.asarray(l)
+    per_step = time.perf_counter() - t0
+    iters = capped_iters(per_step)
     t0 = time.perf_counter()
     for _ in range(iters):
         params, opt_state, l = lm_step(params, opt_state, tokens)
@@ -302,12 +578,19 @@ def bench_train() -> dict:
 RAW_SIZE = 256  # corpus native size; the device-resize staging size
 
 
-def bench_e2e(model: str, batch_size: int, corpus_root: str) -> dict:
+def bench_e2e(
+    model: str, batch_size: int, corpus_root: str, deadline: float | None = None
+) -> dict:
     """JPEG -> top-1 through the overlapped stream pipeline, plus the host
-    decode capacity on its own (the pipeline's ceiling on the host side)."""
+    decode capacity on its own (the pipeline's ceiling on the host side).
+    Deadline-gated between sub-measurements: a degraded tunnel truncates the
+    section (later fields None) instead of blowing the whole-bench budget."""
     from dmlc_tpu.ops import preprocess as pp
     from dmlc_tpu.parallel.inference import InferenceEngine
     from dmlc_tpu.utils import corpus
+
+    def time_left() -> float:
+        return _time_left(deadline)
 
     # Size-suffixed root: a pre-existing corpus of another size can never
     # masquerade as RAW_SIZE (generate() reuses matching layouts blindly).
@@ -336,15 +619,18 @@ def bench_e2e(model: str, batch_size: int, corpus_root: str) -> dict:
     decode_s = time.perf_counter() - t0
 
     # Overlapped end-to-end (decode || transfer || device).
-    t0 = time.perf_counter()
-    engine.run_paths_stream(paths)
-    e2e_s = time.perf_counter() - t0
+    e2e_s = serial_s = None
+    if time_left() > 0:
+        t0 = time.perf_counter()
+        engine.run_paths_stream(paths)
+        e2e_s = time.perf_counter() - t0
 
     # Serial reference (decode, then device, per batch) for the overlap win.
-    t0 = time.perf_counter()
-    for s in range(0, len(paths), batch_size):
-        engine.run_paths(paths[s : s + batch_size])
-    serial_s = time.perf_counter() - t0
+    if time_left() > 0:
+        t0 = time.perf_counter()
+        for s in range(0, len(paths), batch_size):
+            engine.run_paths(paths[s : s + batch_size])
+        serial_s = time.perf_counter() - t0
 
     # Host decode at RAW size (no host resample): the host-side capacity of
     # the device-resize path (ops/device_resize.py). Only the HOST number is
@@ -353,21 +639,24 @@ def bench_e2e(model: str, batch_size: int, corpus_root: str) -> dict:
     # measures the tunnel, not the design (and its extra compile broke the
     # whole-bench time budget); tests/test_device_resize.py pins the chip
     # side, this pins the host-CPU win that transfers to real TPU-VMs.
-    pp.load_batch(paths[:batch_size], size=RAW_SIZE)
-    t0 = time.perf_counter()
-    for s in range(0, len(paths), batch_size):
-        pp.load_batch(paths[s : s + batch_size], size=RAW_SIZE)
-    decode_raw_s = time.perf_counter() - t0
+    decode_raw_s = None
+    if time_left() > 0:
+        pp.load_batch(paths[:batch_size], size=RAW_SIZE)
+        t0 = time.perf_counter()
+        for s in range(0, len(paths), batch_size):
+            pp.load_batch(paths[s : s + batch_size], size=RAW_SIZE)
+        decode_raw_s = time.perf_counter() - t0
 
     n = len(paths)
+    rate = lambda secs: round(n / secs, 1) if secs else None  # noqa: E731
     return {
         "model": model,
         "images": n,
-        "decode_only_img_s": round(n / decode_s, 1),
-        "decode_raw_img_s": round(n / decode_raw_s, 1),
-        "e2e_img_s": round(n / e2e_s, 1),
-        "serial_img_s": round(n / serial_s, 1),
-        "overlap_speedup": round(serial_s / e2e_s, 2),
+        "decode_only_img_s": rate(decode_s),
+        "decode_raw_img_s": rate(decode_raw_s),
+        "e2e_img_s": rate(e2e_s),
+        "serial_img_s": rate(serial_s),
+        "overlap_speedup": round(serial_s / e2e_s, 2) if e2e_s and serial_s else None,
     }
 
 
@@ -409,6 +698,25 @@ def main() -> None:
     t_start = time.monotonic()
     _enable_compile_cache()
 
+    # Previous committed artifact: the per-(model,batch) best-known record
+    # drives degraded-tunnel detection, and skipped sections fall back to the
+    # previous data (stamped stale) instead of overwriting it with nulls.
+    prev_detail = load_prev_detail()
+    history_best = prev_detail.get("history_best") or {}
+
+    # Per-item wall-clock caps (seconds). The global --budget-s gates
+    # STARTING an item; these bound an item once started, so worst case is
+    # budget + one cap, not budget + one unbounded degraded config (round 3
+    # spent 496 s inside four configs against a 300 s budget).
+    CAPS = {
+        "headline": 150.0,
+        "secondary": 75.0,
+        "e2e": 90.0,
+        "flash": 60.0,
+        "curve_point": 30.0,
+        "train": 100.0,
+    }
+
     # Per-model batch tuning, backed by the measured batch curves that land
     # in bench_detail.json["batch_curve"] each run: ResNet-18 peaks at 1024
     # (30.9k img/s MFU 0.53, vs 29.3k @ 512, 26k @ 256, 29.2k @ 2048) and
@@ -443,25 +751,53 @@ def main() -> None:
     while remaining and head is None:
         model = remaining.pop(0)
         try:
-            head = bench_model(model, batch_overrides.get(model, base_batch))
+            head = bench_model(
+                model,
+                batch_overrides.get(model, base_batch),
+                deadline=time.monotonic() + CAPS["headline"],
+            )
         except Exception as e:
             print(f"[bench] {model} FAILED: {type(e).__name__}: {e}", file=sys.stderr)
     if head is None:
         raise SystemExit("no model benched successfully")
+    degraded = degraded_vs_best(head, history_best)
+    if degraded:
+        # One retry: a degraded tunnel window is often transient (round 2's
+        # 30.8k vs round 3's 1.4k were the same code and chip hours apart).
+        best = history_best.get(f"{head['model']}@{head['batch_size']}")
+        print(
+            f"[bench] {head['model']} measured >3x off best-known "
+            f"({head['images_per_sec_per_chip']} img/s/chip vs best {best}); "
+            "retrying once",
+            file=sys.stderr,
+        )
+        try:
+            retry = bench_model(
+                head["model"],
+                head["batch_size"],
+                deadline=time.monotonic() + CAPS["headline"] / 2,
+            )
+            if retry["images_per_sec_per_chip"] > head["images_per_sec_per_chip"]:
+                head = retry
+        except Exception as e:
+            print(f"[bench] retry FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+        degraded = degraded_vs_best(head, history_best)
+    if degraded:
+        head["degraded_vs_history"] = True
     stderr_line(head)
-    print(
-        json.dumps(
-            {
-                "metric": f"{head['model']} ImageNet inference throughput",
-                "value": head["images_per_sec_per_chip"],
-                "unit": "images/sec/chip",
-                # Cluster-to-cluster: our total throughput over the
-                # reference's 4 img/s design cap (2 jobs x 2 qps).
-                "vs_baseline": round(head["images_per_sec"] / 4.0, 1),
-            }
-        ),
-        flush=True,
-    )
+    payload = {
+        "metric": f"{head['model']} ImageNet inference throughput",
+        "value": head["images_per_sec_per_chip"],
+        "unit": "images/sec/chip",
+        # Cluster-to-cluster: our total throughput over the
+        # reference's 4 img/s design cap (2 jobs x 2 qps).
+        "vs_baseline": round(head["images_per_sec"] / 4.0, 1),
+    }
+    if degraded:
+        # Self-documenting record: this number is a tunnel-weather artifact,
+        # not the chip-side rate — see bench_detail.json["history_best"].
+        payload["degraded_tunnel"] = True
+    print(json.dumps(payload), flush=True)
 
     def over_budget(what: str) -> bool:
         elapsed = time.monotonic() - t_start
@@ -484,18 +820,29 @@ def main() -> None:
             # pass vs 12.0k best-of-2); with the compile cache there is
             # budget to spare.
             r = bench_model(
-                model, batch_overrides.get(model, base_batch), seconds=3.0, passes=2
+                model,
+                batch_overrides.get(model, base_batch),
+                seconds=3.0,
+                passes=2,
+                deadline=time.monotonic() + CAPS["secondary"],
             )
         except Exception as e:
             print(f"[bench] {model} FAILED: {type(e).__name__}: {e}", file=sys.stderr)
             continue
+        if degraded_vs_best(r, history_best):
+            r["degraded_vs_history"] = True
         results.append(r)
         stderr_line(r)
 
     e2e = None
     if args.e2e and not over_budget("e2e"):
         try:
-            e2e = bench_e2e(head["model"], base_batch, args.corpus)
+            e2e = bench_e2e(
+                head["model"],
+                base_batch,
+                args.corpus,
+                deadline=time.monotonic() + CAPS["e2e"],
+            )
             print(
                 f"[bench-e2e] {e2e['model']} images={e2e['images']} "
                 f"decode_only={e2e['decode_only_img_s']} img/s "
@@ -514,7 +861,7 @@ def main() -> None:
     flash = {}
     if not over_budget("flash"):
         try:
-            flash = bench_flash()
+            flash = bench_flash(deadline=time.monotonic() + CAPS["flash"])
             for key, r in flash.items():
                 print(
                     f"[bench-flash] {key}: flash {r['flash_ms']}ms "
@@ -544,16 +891,28 @@ def main() -> None:
                 if over_budget(f"curve {model}@{bs}"):
                     continue
                 try:
-                    r = bench_model(model, bs, seconds=1.5, passes=1, latency_iters=0)
+                    r = bench_model(
+                        model,
+                        bs,
+                        seconds=1.5,
+                        passes=1,
+                        latency_iters=0,
+                        max_passes=1,
+                        deadline=time.monotonic() + CAPS["curve_point"],
+                    )
                 except Exception as e:
                     print(
                         f"[bench-curve] {model}@{bs} FAILED: {type(e).__name__}: {e}",
                         file=sys.stderr,
                     )
                     continue
-            curve.setdefault(model, []).append(
-                {"batch_size": bs, "images_per_sec_per_chip": r["images_per_sec_per_chip"]}
-            )
+            entry = {
+                "batch_size": bs,
+                "images_per_sec_per_chip": r["images_per_sec_per_chip"],
+            }
+            if r.get("degraded_vs_history") or degraded_vs_best(r, history_best):
+                entry["degraded_vs_history"] = True
+            curve.setdefault(model, []).append(entry)
         for model, pts in curve.items():
             pts.sort(key=lambda p: p["batch_size"])
             line = " ".join(
@@ -567,7 +926,7 @@ def main() -> None:
     train = {}
     if not over_budget("train"):
         try:
-            train = bench_train()
+            train = bench_train(deadline=time.monotonic() + CAPS["train"])
             for key, r in train.items():
                 rate = r.get("images_per_sec") or r.get("tokens_per_sec")
                 unit = "img/s" if "images_per_sec" in r else "tok/s"
@@ -580,18 +939,21 @@ def main() -> None:
         except Exception as e:
             print(f"[bench-train] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
 
-    Path("bench_detail.json").write_text(
-        json.dumps(
-            {
-                "configs": results,
-                "e2e": e2e,
-                "batch_curve": curve,
-                "flash": flash,
-                "train": train,
-            },
-            indent=2,
-        )
-    )
+    new_detail = {
+        "captured_at": round(time.time(), 1),
+        "configs": results,
+        "e2e": e2e,
+        "batch_curve": curve,
+        "flash": flash,
+        "train": train,
+    }
+    if degraded:
+        new_detail["degraded_tunnel"] = True
+    # Atomic replace: a crash mid-write must never leave a truncated
+    # artifact (which would cost the whole degradation history next run).
+    tmp = Path("bench_detail.json.tmp")
+    tmp.write_text(json.dumps(merge_detail(new_detail, prev_detail), indent=2))
+    tmp.replace("bench_detail.json")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,274 @@
+"""Weather-proofing guards in bench.py (round-3 post-mortem).
+
+The round-3 driver capture ran in a degraded-tunnel window: every config
+measured ~1/20th of its known rate, the bench blew its own budget, and the
+artifact writer overwrote committed e2e/flash/train sections with nulls.
+These tests pin the pure-logic guards that prevent a recurrence:
+
+- ``degraded_vs_best``: >3x-off-best detection (latency OR throughput).
+- ``update_history_best``: degraded runs never improve the record.
+- ``merge_detail``: skipped sections keep previous data stamped stale.
+"""
+
+import json
+import subprocess
+import sys
+
+import bench
+
+
+def _cfg(model="resnet18", batch=1024, ips=30000.0, p50=140.0, **kw):
+    return dict(
+        {
+            "model": model,
+            "batch_size": batch,
+            "images_per_sec_per_chip": ips,
+            "p50_ms": p50,
+        },
+        **kw,
+    )
+
+
+HB = {"resnet18@1024": {"images_per_sec_per_chip": 31033.6, "p50_ms": 140.41}}
+
+
+class TestDegradedVsBest:
+    def test_healthy_run_not_flagged(self):
+        assert not bench.degraded_vs_best(_cfg(ips=29000, p50=150), HB)
+
+    def test_throughput_collapse_flagged(self):
+        # The literal round-3 capture: 1407 img/s vs best 31033.
+        assert bench.degraded_vs_best(_cfg(ips=1407.5, p50=821.04), HB)
+
+    def test_latency_collapse_alone_flagged(self):
+        assert bench.degraded_vs_best(_cfg(ips=29000, p50=600.0), HB)
+
+    def test_unknown_config_never_flagged(self):
+        assert not bench.degraded_vs_best(_cfg(model="vit_b16", ips=1.0), HB)
+
+    def test_best_without_p50_uses_throughput(self):
+        hb = {"resnet18@512": {"images_per_sec_per_chip": 20619.6, "p50_ms": None}}
+        assert bench.degraded_vs_best(_cfg(batch=512, ips=5000, p50=None), hb)
+        assert not bench.degraded_vs_best(_cfg(batch=512, ips=19000, p50=None), hb)
+
+
+class TestHistoryBest:
+    def test_degraded_never_improves_record(self):
+        out = bench.update_history_best(HB, [_cfg(ips=1407.5, p50=821.0)])
+        assert out["resnet18@1024"]["images_per_sec_per_chip"] == 31033.6
+
+    def test_better_run_advances_record(self):
+        out = bench.update_history_best(HB, [_cfg(ips=32000.0, p50=135.0)])
+        assert out["resnet18@1024"] == {
+            "images_per_sec_per_chip": 32000.0,
+            "p50_ms": 135.0,
+        }
+
+    def test_new_config_added(self):
+        out = bench.update_history_best(HB, [_cfg(model="vit_b16", batch=256, ips=2227.8)])
+        assert "vit_b16@256" in out and len(out) == 2
+
+
+class TestMergeDetail:
+    OLD = {
+        "configs": [_cfg(), _cfg(model="resnet50", batch=512, ips=11583.9, p50=145.8)],
+        "e2e": {"model": "resnet18", "e2e_img_s": 31.5},
+        "batch_curve": {
+            "resnet18": [
+                {"batch_size": 512, "images_per_sec_per_chip": 20619.6},
+                {"batch_size": 1024, "images_per_sec_per_chip": 31033.6},
+            ]
+        },
+        "flash": {"s2048_h8": {"flash_ms": 5.73}},
+        "train": {"vit_b16_train": {"images_per_sec": 846.6}},
+        "history_best": HB,
+    }
+
+    def test_skipped_sections_kept_and_stamped_stale(self):
+        # A budget-truncated run: only the headline config landed.
+        new = {"configs": [_cfg(ips=30500)], "e2e": None, "batch_curve": {}, "flash": {}, "train": {}}
+        out = bench.merge_detail(new, self.OLD)
+        assert out["e2e"]["e2e_img_s"] == 31.5 and out["e2e"]["stale"] is True
+        # Staleness is stamped INSIDE each kept entry, never at section
+        # level where consumers iterate entries.
+        assert out["flash"]["s2048_h8"] == {"flash_ms": 5.73, "stale": True}
+        assert "stale" not in out["flash"]
+        assert out["train"]["vit_b16_train"]["stale"] is True
+        assert "stale" not in out["train"]
+        # Un-re-measured config kept stale; fresh one not stamped.
+        by_model = {r["model"]: r for r in out["configs"]}
+        assert by_model["resnet50"]["stale"] is True
+        assert "stale" not in by_model["resnet18"]
+
+    def test_partial_section_keeps_missing_entries(self):
+        # Deadline truncation mid-section: train reached only vit_b16_train,
+        # flash only s2048_h8 — the un-reached entries must survive.
+        old = dict(self.OLD, train={"vit_b16_train": {"images_per_sec": 846.6},
+                                    "lm_flash_train": {"tokens_per_sec": 89356.0}},
+                   flash={"s2048_h8": {"flash_ms": 5.73}, "s8192_h2": {"flash_ms": 6.85}})
+        new = {"configs": [_cfg()],
+               "flash": {"s2048_h8": {"flash_ms": 5.6}},
+               "train": {"vit_b16_train": {"images_per_sec": 850.0}}}
+        out = bench.merge_detail(new, old)
+        assert out["train"]["vit_b16_train"] == {"images_per_sec": 850.0}
+        assert out["train"]["lm_flash_train"]["tokens_per_sec"] == 89356.0
+        assert out["train"]["lm_flash_train"]["stale"] is True
+        assert out["flash"]["s8192_h2"]["stale"] is True
+        assert "stale" not in out["flash"]["s2048_h8"]
+
+    def test_partial_e2e_fields_fall_back(self):
+        # bench_e2e truncated after decode: device fields are None and must
+        # fall back to the previous run's values, stamped stale.
+        old = dict(self.OLD, e2e={"model": "resnet18", "decode_only_img_s": 300.0,
+                                  "e2e_img_s": 31.5, "serial_img_s": 47.0})
+        new = {"configs": [_cfg()],
+               "e2e": {"model": "resnet18", "decode_only_img_s": 310.0,
+                       "e2e_img_s": None, "serial_img_s": None}}
+        out = bench.merge_detail(new, old)
+        assert out["e2e"]["decode_only_img_s"] == 310.0
+        assert out["e2e"]["e2e_img_s"] == 31.5
+        assert out["e2e"]["stale"] is True
+
+    def test_configs_keyed_by_model_and_batch(self):
+        # A --batch-size 256 fallback run must not erase the batch-1024
+        # headline row README cites.
+        new = {"configs": [_cfg(batch=256, ips=26000, p50=38.0)]}
+        out = bench.merge_detail(new, self.OLD)
+        rows = {(r["model"], r["batch_size"]): r for r in out["configs"]}
+        assert ("resnet18", 256) in rows and "stale" not in rows[("resnet18", 256)]
+        assert rows[("resnet18", 1024)]["stale"] is True
+
+    def test_degraded_curve_point_cannot_replace_healthy(self):
+        new = {"configs": [],
+               "batch_curve": {"resnet18": [
+                   {"batch_size": 1024, "images_per_sec_per_chip": 1400.0,
+                    "degraded_vs_history": True},
+                   {"batch_size": 2048, "images_per_sec_per_chip": 27000.0}]}}
+        out = bench.merge_detail(new, self.OLD)
+        pts = {p["batch_size"]: p for p in out["batch_curve"]["resnet18"]}
+        assert pts[1024]["images_per_sec_per_chip"] == 31033.6  # healthy kept
+        assert pts[1024]["stale"] is True
+        assert pts[2048]["images_per_sec_per_chip"] == 27000.0  # new batch ok
+        # And the degraded point never feeds history_best; the healthy one does.
+        assert out["history_best"]["resnet18@1024"]["images_per_sec_per_chip"] == 31033.6
+        assert out["history_best"]["resnet18@2048"]["images_per_sec_per_chip"] == 27000.0
+
+    def test_degraded_config_cannot_replace_healthy_row(self):
+        # A round-3-style run: the headline is still >3x off after the retry
+        # and lands flagged. The committed healthy row must survive; the
+        # garbage number lives in the driver's BENCH_r*.json, not here.
+        new = {"configs": [_cfg(ips=1407.5, p50=821.0, degraded_vs_history=True)],
+               "degraded_tunnel": True}
+        out = bench.merge_detail(new, self.OLD)
+        rows = {(r["model"], r["batch_size"]): r for r in out["configs"]}
+        row = rows[("resnet18", 1024)]
+        assert row["images_per_sec_per_chip"] == 30000.0
+        assert row["stale"] is True
+        # But with no healthy history, the degraded row is kept (flagged).
+        out2 = bench.merge_detail(new, {})
+        assert out2["configs"][0]["degraded_vs_history"] is True
+
+    def test_partial_e2e_for_different_model_keeps_old_whole(self):
+        old = dict(self.OLD, e2e={"model": "resnet18", "decode_only_img_s": 300.0,
+                                  "e2e_img_s": 31.5})
+        new = {"configs": [],
+               "e2e": {"model": "resnet50", "decode_only_img_s": 250.0,
+                       "e2e_img_s": None}}
+        out = bench.merge_detail(new, old)
+        # resnet18's rates must not be attributed to resnet50.
+        assert out["e2e"]["model"] == "resnet18"
+        assert out["e2e"]["e2e_img_s"] == 31.5 and out["e2e"]["stale"] is True
+        # A COMPLETE section for the new model replaces the old outright.
+        new2 = {"configs": [],
+                "e2e": {"model": "resnet50", "decode_only_img_s": 250.0,
+                        "e2e_img_s": 28.0}}
+        out2 = bench.merge_detail(new2, old)
+        assert out2["e2e"]["model"] == "resnet50" and "stale" not in out2["e2e"]
+
+    def test_curve_best_preserves_p50_reference(self):
+        # A curve point (no latency loop) that beats the record must not
+        # erase the p50 the latency-degradation check compares against.
+        new = {"configs": [],
+               "batch_curve": {"resnet18": [
+                   {"batch_size": 1024, "images_per_sec_per_chip": 32000.0}]}}
+        out = bench.merge_detail(new, self.OLD)
+        hb = out["history_best"]["resnet18@1024"]
+        assert hb["images_per_sec_per_chip"] == 32000.0
+        assert hb["p50_ms"] == 140.41
+
+    def test_fresh_sections_replace_without_stale(self):
+        new = {
+            "configs": [_cfg()],
+            "e2e": {"model": "resnet18", "e2e_img_s": 40.0},
+            "batch_curve": {"resnet18": [{"batch_size": 1024, "images_per_sec_per_chip": 31500.0}]},
+            "flash": {"s2048_h8": {"flash_ms": 5.5}},
+            "train": {"vit_b16_train": {"images_per_sec": 850.0}},
+        }
+        out = bench.merge_detail(new, self.OLD)
+        assert "stale" not in out["e2e"] and out["e2e"]["e2e_img_s"] == 40.0
+        assert "stale" not in out["flash"]
+        # Curve merges per point: re-measured 1024 fresh, old 512 stale.
+        pts = {p["batch_size"]: p for p in out["batch_curve"]["resnet18"]}
+        assert "stale" not in pts[1024] and pts[1024]["images_per_sec_per_chip"] == 31500.0
+        assert pts[512]["stale"] is True
+
+    def test_history_best_carried_and_updated(self):
+        new = {"configs": [_cfg(ips=32000.0, p50=135.0)]}
+        out = bench.merge_detail(new, self.OLD)
+        assert out["history_best"]["resnet18@1024"]["images_per_sec_per_chip"] == 32000.0
+
+    def test_degraded_run_does_not_poison_history(self):
+        new = {"configs": [_cfg(ips=1407.5, p50=821.0)], "degraded_tunnel": True}
+        out = bench.merge_detail(new, self.OLD)
+        assert out["degraded_tunnel"] is True
+        assert out["history_best"]["resnet18@1024"]["images_per_sec_per_chip"] == 31033.6
+        # And a later healthy merge drops the flag.
+        out2 = bench.merge_detail({"configs": [_cfg()]}, out)
+        assert "degraded_tunnel" not in out2
+
+    def test_empty_old_artifact(self):
+        new = {"configs": [_cfg()], "e2e": None, "flash": {}, "train": {}}
+        out = bench.merge_detail(new, {})
+        assert out["e2e"] is None and out["flash"] == {}
+        assert out["history_best"]["resnet18@1024"]["images_per_sec_per_chip"] == 30000.0
+
+
+def test_load_prev_detail_preserves_corrupt_file(tmp_path, capsys):
+    """A truncated/corrupt artifact is moved aside with a warning, never
+    silently treated as absent (which would disable every guard)."""
+    p = tmp_path / "bench_detail.json"
+    p.write_text('{"configs": [trunca')
+    out = bench.load_prev_detail(str(p))
+    assert out == {}
+    assert not p.exists()
+    corrupt = tmp_path / "bench_detail.json.corrupt"
+    assert corrupt.read_text().startswith('{"configs"')
+    assert "unparseable" in capsys.readouterr().err
+    # Valid JSON of the wrong shape is preserved the same way, not silently
+    # treated as absent (the atomic replace would then destroy it).
+    p2 = tmp_path / "shape.json"
+    p2.write_text('["not", "an", "object"]')
+    assert bench.load_prev_detail(str(p2)) == {}
+    assert not p2.exists() and (tmp_path / "shape.json.corrupt").exists()
+    assert "unparseable" in capsys.readouterr().err
+    # A missing file stays silent.
+    assert bench.load_prev_detail(str(tmp_path / "nope.json")) == {}
+    assert capsys.readouterr().err == ""
+
+
+def test_committed_artifact_has_all_sections_and_history():
+    """The committed artifact must never again lose sections README/PARITY
+    cite: every section present and non-empty, history_best populated."""
+    detail = json.loads((bench.Path(__file__).parents[1] / "bench_detail.json").read_text())
+    for key in ("configs", "e2e", "batch_curve", "flash", "train", "history_best"):
+        assert detail.get(key), f"bench_detail.json[{key!r}] missing or empty"
+    assert detail["history_best"].get("resnet18@1024", {}).get(
+        "images_per_sec_per_chip", 0
+    ) > 10000, "history_best lost the healthy headline record"
+
+
+def test_bench_py_compiles():
+    subprocess.run(
+        [sys.executable, "-m", "py_compile", str(bench.Path(bench.__file__))],
+        check=True,
+    )
