@@ -1,4 +1,4 @@
-"""Shared-key frame authentication for the control plane.
+"""Shared-key frame authentication + replay protection for the control plane.
 
 The reference's control plane was only as safe as its network: any process
 that could reach a tarpc port could call Leader/Member services directly
@@ -9,53 +9,130 @@ frame when ``ClusterConfig.auth_key`` is set: unauthenticated or tampered
 frames are dropped before any payload parsing, so reaching a port no longer
 grants ``sdfs.delete`` / ``job.start``.
 
+Replay protection: every sealed frame carries a per-sender monotonic
+sequence number (nanosecond clock, forced strictly increasing per process)
+inside the MAC'd region. A receiver tracks, per sender, the highest sequence
+seen plus a sliding window of recently accepted values:
+
+- a frame at or below ``highest - window`` is rejected (too old),
+- a frame inside the window that was already accepted is rejected (replay),
+- out-of-order but fresh UDP datagrams inside the window still pass,
+- the FIRST frame from a sender this receiver has no state for must be
+  within ``max_age_s`` of the receiver's clock — so a recorded frame cannot
+  be replayed against a freshly restarted receiver long after capture.
+  (Within ``max_age_s`` of capture, a restart-then-replay races the real
+  sender's next frame; the bound is freshness, not perfect one-shot
+  semantics. The reference had no authentication at all.)
+
 Design notes:
 - The tag is truncated to 16 bytes (standard HMAC truncation; 128-bit
   forgery resistance) to keep gossip datagrams small.
 - Authentication, not encryption: payloads are readable on the wire, they
-  just cannot be forged or altered. Matches the threat ("any host can write
-  to the control plane"), not a full TLS story.
-- No replay protection: a recorded `sdfs.delete` frame could be replayed
-  while the key is unchanged. The reference had no protection at all; nonce
-  windows are a deliberate non-goal at this layer.
+  just cannot be forged, altered, or replayed. Matches the threat ("any
+  host can write to the control plane"), not a full TLS story.
+- The freshness bound assumes fleet clocks within ``max_age_s`` (default
+  120 s) of each other — ordinary NTP territory, and only consulted for
+  senders with no receiver-side state yet.
 """
 
 from __future__ import annotations
 
 import hmac
 import hashlib
+import os
+import struct
+import threading
+import time
 
 
 TAG_BYTES = 16
+_HDR = struct.Struct("!QB")  # sequence (ns clock), sender-id length
+_MAX_SENDERS = 1024  # replay-state LRU bound: gossip fan-in is << this
 
 
 class AuthError(Exception):
-    """Frame failed authentication (missing, truncated, or wrong tag)."""
+    """Frame failed authentication (missing, truncated, wrong tag, replay)."""
 
 
 class FrameAuth:
-    """Seals/opens byte frames with a truncated HMAC-SHA256 tag."""
+    """Seals/opens byte frames: truncated HMAC-SHA256 tag over a
+    (sequence, sender, payload) envelope, with receiver-side replay windows.
 
-    def __init__(self, key: str | bytes):
+    One instance per process endpoint; safe for concurrent use (server
+    connection threads share the receiver state under a lock).
+    """
+
+    def __init__(
+        self,
+        key: str | bytes,
+        sender: str | None = None,
+        window_s: float = 60.0,
+        max_age_s: float = 120.0,
+    ):
         if not key:
             raise ValueError("FrameAuth requires a non-empty key")
         self._key = key.encode() if isinstance(key, str) else bytes(key)
+        sid = (sender or os.urandom(8).hex()).encode()
+        if len(sid) > 255:
+            raise ValueError("sender id longer than 255 bytes")
+        self._sender = sid
+        self._window_ns = int(window_s * 1e9)
+        self._max_age_ns = int(max_age_s * 1e9)
+        self._lock = threading.Lock()
+        self._last_seq = 0
+        # sender id -> (highest seq seen, set of accepted seqs in window)
+        self._peers: dict[bytes, tuple[int, set[int]]] = {}
 
     def _tag(self, data: bytes) -> bytes:
         return hmac.new(self._key, data, hashlib.sha256).digest()[:TAG_BYTES]
 
     def seal(self, data: bytes) -> bytes:
-        return self._tag(data) + data
+        with self._lock:
+            seq = max(self._last_seq + 1, time.time_ns())
+            self._last_seq = seq
+        body = _HDR.pack(seq, len(self._sender)) + self._sender + data
+        return self._tag(body) + body
 
     def open(self, frame: bytes) -> bytes:
-        if len(frame) < TAG_BYTES:
-            raise AuthError(f"frame of {len(frame)} bytes is shorter than the tag")
-        tag, data = frame[:TAG_BYTES], frame[TAG_BYTES:]
-        if not hmac.compare_digest(tag, self._tag(data)):
+        if len(frame) < TAG_BYTES + _HDR.size:
+            raise AuthError(f"frame of {len(frame)} bytes is shorter than the envelope")
+        tag, body = frame[:TAG_BYTES], frame[TAG_BYTES:]
+        if not hmac.compare_digest(tag, self._tag(body)):
             raise AuthError("bad frame tag")
-        return data
+        seq, sender_len = _HDR.unpack_from(body)
+        sender = body[_HDR.size : _HDR.size + sender_len]
+        if len(sender) != sender_len:
+            raise AuthError("truncated sender id")
+        self._check_replay(sender, seq)
+        return body[_HDR.size + sender_len :]
+
+    def _check_replay(self, sender: bytes, seq: int) -> None:
+        with self._lock:
+            state = self._peers.get(sender)
+            if state is None:
+                if abs(seq - time.time_ns()) > self._max_age_ns:
+                    raise AuthError("stale frame from unknown sender")
+                if len(self._peers) >= _MAX_SENDERS:
+                    # Evict the peer with the oldest highest-seen sequence:
+                    # a flood of fake sender ids cannot grow state unboundedly.
+                    evict = min(self._peers, key=lambda s: self._peers[s][0])
+                    del self._peers[evict]
+                self._peers[sender] = (seq, {seq})
+                return
+            highest, seen = state
+            floor = highest - self._window_ns
+            if seq <= floor:
+                raise AuthError("frame sequence below replay window")
+            if seq in seen:
+                raise AuthError("replayed frame")
+            if seq > highest:
+                highest = seq
+                floor = highest - self._window_ns
+                seen = {s for s in seen if s > floor}
+            seen.add(seq)
+            self._peers[sender] = (highest, seen)
 
 
-def maybe_auth(key: str | bytes | None) -> FrameAuth | None:
+def maybe_auth(key: str | bytes | None, sender: str | None = None) -> FrameAuth | None:
     """Config plumbing: '' / None mean authentication disabled."""
-    return FrameAuth(key) if key else None
+    return FrameAuth(key, sender=sender) if key else None

@@ -87,9 +87,13 @@ class StandbyLeader:
     than any term it has observed ([counter+1, self]), members reject SDFS
     writes from older terms (SdfsMember fencing), and on heal the claimant
     with the older term sees the newer one and abdicates — so a write acked
-    by a stale claimant is (a) almost always impossible (its placements are
-    rejected) and (b) never silently replaced under the same version by the
-    winning term's directory without having been refused first.
+    by a stale claimant is (a) rejected at every member whose fence has seen
+    the newer term and (b) never silently replaced under the same version by
+    the winning term's directory without having been refused first. The
+    fence persists across member restarts (SdfsMember._save_fence), so the
+    remaining window is a member that was UNREACHABLE during fence_members()
+    and has never seen a newer-term write: it stays legacy-open to the stale
+    claimant until the first fenced write reaches it.
     """
 
     def __init__(
@@ -212,7 +216,13 @@ class StandbyLeader:
             # still tightens as writes carry the epoch). Then rebuild
             # reservations from member inventories, so versions acked by the
             # old term but never mirrored here are not re-issued.
-            self.sdfs_leader.fence_members()
+            # fence_members may ADOPT a newer term if member fences outrank
+            # ours (persisted fences after a full restart) — keep the
+            # failover's and scheduler's view of the epoch in lockstep.
+            adopted = self.sdfs_leader.fence_members()
+            if epoch_key(adopted) > epoch_key(self.seen_epoch):
+                self.seen_epoch = list(adopted)
+                self.scheduler.epoch = list(adopted)
             self.sdfs_leader.reconcile_from_members()
         if self.mesh_bootstrap is not None:
             self.mesh_bootstrap.is_leading = True

@@ -84,7 +84,11 @@ class ClusterNode:
 
         self.config = config
         self.clock = Clock()
-        self.auth = maybe_auth(config.auth_key)
+        # Sender identity binds this node's address into every sealed frame's
+        # replay sequence track (auth.py: per-sender monotonic windows).
+        self.auth = maybe_auth(
+            config.auth_key, sender=f"{config.host}:{config.gossip_port}"
+        )
         self.rpc = TcpRpc(auth=self.auth)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []

@@ -203,8 +203,34 @@ class SdfsMember:
         # order): writes carrying an OLDER term are rejected — a stale
         # claimant on the wrong side of a candidate partition cannot land
         # (or overwrite) blobs here. None until the first fenced write.
-        self._fence: tuple[int, str] | None = None
+        # PERSISTED as a sibling of the store dir (which the boot wipe
+        # recreates): a member that restarts after being fenced would
+        # otherwise come back legacy-open and accept a stale claimant's
+        # writes until the first newer-epoch write arrived (ADVICE r3).
+        self._fence_path = store.dir.parent / (store.dir.name + ".fence")
+        self._fence: tuple[int, str] | None = self._load_fence()
         self._fence_lock = threading.Lock()
+
+    def _load_fence(self) -> tuple[int, str] | None:
+        try:
+            import json
+
+            raw = json.loads(self._fence_path.read_text())
+            return int(raw[0]), str(raw[1])
+        except Exception:
+            return None
+
+    def _save_fence(self) -> None:
+        """Atomic write, called under ``_fence_lock``. Best-effort: a node
+        that cannot persist still fences in memory for this incarnation."""
+        try:
+            import json
+
+            tmp = self._fence_path.with_name(self._fence_path.name + ".tmp")
+            tmp.write_text(json.dumps(list(self._fence)))
+            tmp.replace(self._fence_path)
+        except OSError:
+            log.warning("could not persist epoch fence", exc_info=True)
 
     def _check_epoch(self, p: dict) -> None:
         from dmlc_tpu.cluster.failover import epoch_key
@@ -218,11 +244,26 @@ class SdfsMember:
                 raise RpcError(
                     f"stale leadership epoch {list(key)} < fenced {list(self._fence)}"
                 )
-            self._fence = max(self._fence or key, key)
+            new = max(self._fence or key, key)
+            if new != self._fence:
+                self._fence = new
+                self._save_fence()
 
     def _fence_rpc(self, p: dict) -> dict:
-        self._check_epoch(p)
+        """Fence announcement/probe. Unlike data writes, a STALE term's
+        announcement is not an error: the reply always carries this member's
+        current fence, so a leader whose epoch counter reset (full-cluster
+        restart; fences persist, SdfsLeader.epoch does not) can DISCOVER the
+        newer fence and adopt past it instead of bouncing writes forever."""
+        from dmlc_tpu.cluster.failover import epoch_key
+
+        epoch = p.get("epoch")
         with self._fence_lock:
+            if epoch is not None:
+                key = epoch_key(epoch)
+                if self._fence is None or key > self._fence:
+                    self._fence = key
+                    self._save_fence()
             return {"epoch": list(self._fence) if self._fence else None}
 
     def methods(self) -> dict:
@@ -465,16 +506,36 @@ class SdfsLeader:
                     log.warning("%s %s failed: %s", what, m, e)
         return results
 
-    def fence_members(self) -> None:
+    def fence_members(self) -> list:
         """Best-effort fence announcement to every reachable member: they
         learn this term before it accepts writes, so a stale claimant's
-        subsequent placements are rejected rather than raced."""
-        self._for_each_member(
-            "fence",
-            lambda m: self.rpc.call(
-                m, "sdfs.fence", {"epoch": list(self.epoch)}, timeout=2.0
-            ),
-        )
+        subsequent placements are rejected rather than raced.
+
+        Members report their current fence back. If any member holds a
+        NEWER term than ours — persisted fences survive a full-cluster
+        restart while the epoch counter resets — this term adopts a
+        strictly newer one and re-announces, so recovery never depends on
+        members forgetting their fences. Returns the final epoch."""
+        from dmlc_tpu.cluster.failover import epoch_key
+
+        for _ in range(3):  # adopt + re-announce is bounded, not a loop
+            replies = self._for_each_member(
+                "fence",
+                lambda m: self.rpc.call(
+                    m, "sdfs.fence", {"epoch": list(self.epoch)}, timeout=2.0
+                ),
+            )
+            fences = [r.get("epoch") for _, r in replies if r.get("epoch")]
+            top = max(fences, key=epoch_key) if fences else None
+            if top is None or epoch_key(top) <= epoch_key(self.epoch):
+                break
+            with self._lock:
+                self.epoch = [int(top[0]) + 1, self.epoch[1]]
+            log.warning(
+                "member fence %s newer than our term; adopted epoch %s",
+                top, self.epoch,
+            )
+        return list(self.epoch)
 
     def reconcile_from_members(self) -> None:
         """Promotion-time inventory sync: fold every reachable member's

@@ -118,11 +118,16 @@ def _auto_block(s: int, requested: int | None, default: int) -> int:
     """Largest Mosaic-LEGAL block for a sequence of length ``s``: a divisor
     of s that is also a multiple of 8 (the TPU lowering requires block dims
     divisible by 8 unless equal to the array dim), not exceeding the
-    requested size — S=192 with 128-blocks runs at blk=64. Sequences with
+    requested size — S=192 with 128-blocks runs at blk=96 (the largest
+    divisor of 192 that is a multiple of 8 and <= 128). Sequences with
     no such divisor (odd S, primes) fall back to ONE full-S block — always
     layout-legal, but its [S, S] score tile must fit VMEM, hence capped at
-    _FULL_BLOCK_CAP."""
+    _FULL_BLOCK_CAP. An explicit request >= s for a sequence past that cap
+    searches for a smaller divisor instead of demanding padding the
+    sequence does not need."""
     blk = min(requested if requested is not None else default, s)
+    if blk >= s and s > _FULL_BLOCK_CAP:
+        blk = min(default, s - 8)
     if blk < s:
         for d in range(blk - blk % 8, 7, -8):
             if s % d == 0:

@@ -625,18 +625,33 @@ class JobScheduler:
 
     def has_dispatchable(self) -> bool:
         """Any job with reservable work right now? (Cheap idle check for
-        dispatcher threads.)"""
+        dispatcher threads.) Gang-mode jobs count only when their assignment
+        matches the registered mesh group — a stale assignment dispatches
+        nothing until the next assign pass, and hedging is unreachable on
+        the gang path — so dispatcher threads sleep instead of busy-spinning
+        through no-op polls (ADVICE r3)."""
         with self._lock:
-            return any(
-                j.running
-                and j.assigned
-                and (
+            # The mesh group is job-independent: resolve the callback once
+            # per poll, not once per job (this runs on the dispatcher idle
+            # path every tick).
+            group = self.mesh_group() if self.mesh_group is not None else None
+            gang = set(group) if group else None
+            for j in self.jobs.values():
+                if not (j.running and j.assigned):
+                    continue
+                if gang is not None:
+                    if set(j.assigned) == gang and (
+                        j.retry_q or j.next_offset < len(j.queries)
+                    ):
+                        return True
+                    continue
+                if (
                     j.retry_q
                     or j.next_offset < len(j.queries)
                     or self._hedgeable_offset(j) is not None
-                )
-                for j in self.jobs.values()
-            )
+                ):
+                    return True
+            return False
 
     def run_to_completion(self, max_rounds: int = 100_000) -> None:
         """Drive all running jobs until done (used by tests and the CLI's

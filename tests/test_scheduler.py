@@ -620,6 +620,25 @@ def _gang_fixture(n_queries=40, shard=8):
     return net, sched, calls
 
 
+def test_gang_stale_assignment_not_dispatchable():
+    """ADVICE r3: while a mesh group is registered but the job's assignment
+    does not match it yet (stale, pre-assign), dispatch_once is a no-op —
+    has_dispatchable must say False so dispatcher threads sleep instead of
+    busy-spinning; once the assignment matches, work counts again."""
+    net, sched, calls = _gang_fixture(n_queries=40, shard=8)
+    sched._start({})
+    # Pre-assign: job started, mesh registered, no assignment yet.
+    assert sched.jobs["resnet18"].running
+    sched.jobs["resnet18"].assigned = ["m0"]  # stale: not the mesh group
+    assert not sched.has_dispatchable()
+    assert sched.dispatch_once("resnet18") == 0
+    sched.assign_once()  # reconciles assignment to the mesh group
+    assert sched.has_dispatchable()
+    sched.run_to_completion()
+    assert sched.jobs["resnet18"].finished == 40
+    assert not sched.has_dispatchable()
+
+
 def test_gang_dispatch_collective_shards_exactly_once():
     """A job whose assigned members are exactly the registered mesh group
     dispatches every shard to ALL of them (one collective execution per

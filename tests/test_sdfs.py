@@ -364,3 +364,57 @@ def test_reconcile_does_not_resurrect_deleted_files(tmp_path):
         1 not in vs
         for vs in cl.leader.state.directory.get("f", {}).values()
     ) or cl.leader.state.replicas_of("f", 1) == []
+
+
+def test_epoch_fence_survives_member_restart(tmp_path):
+    """ADVICE r3: the epoch fence was in-memory only, so a member that
+    restarted after fencing came back legacy-open and a stale claimant
+    could land acked writes until the first newer-epoch write arrived.
+    The fence now persists as a sibling of the store dir (the boot wipe
+    recreates the dir itself)."""
+    from dmlc_tpu.cluster.rpc import SimRpcNetwork
+    from dmlc_tpu.cluster.sdfs import MemberStore, SdfsMember
+
+    net = SimRpcNetwork()
+    store = MemberStore(tmp_path / "m0")
+    member = SdfsMember(store, net.client("m0"))
+    # A fenced write at term [3, "L2"] raises the member's fence.
+    member._receive({"name": "f", "version": 1, "data": b"x", "epoch": [3, "L2"]})
+    with pytest.raises(RpcError, match="stale leadership epoch"):
+        member._receive({"name": "g", "version": 1, "data": b"y", "epoch": [2, "L1"]})
+
+    # Restart: the boot wipe recreates the store dir, but the fence file
+    # (sibling) survives and the stale claimant is still rejected.
+    store2 = MemberStore(tmp_path / "m0")
+    member2 = SdfsMember(store2, net.client("m0"))
+    assert member2._fence == (3, "L2")
+    with pytest.raises(RpcError, match="stale leadership epoch"):
+        member2._receive({"name": "g", "version": 1, "data": b"y", "epoch": [2, "L1"]})
+    # Newer terms still pass and advance the persisted fence.
+    member2._receive({"name": "h", "version": 1, "data": b"z", "epoch": [4, "L3"]})
+    assert SdfsMember(MemberStore(tmp_path / "m0"), net.client("m0"))._fence == (4, "L3")
+
+
+def test_full_restart_recovers_past_persisted_fences(tmp_path):
+    """Review r4: with fences persisted, a FULL-cluster restart (leader
+    epoch counter resets to its default while member fences survive on
+    disk) must not reject writes forever. fence_members discovers the
+    newer member fences from their replies and adopts a strictly newer
+    term, so the restarted cluster writes again."""
+    cl = Cluster(tmp_path, n=3, rf=2)
+    # Old incarnation fenced every member at term [7, "old-leader"].
+    cl.leader.epoch = [7, "old-leader"]
+    cl.leader.fence_members()
+
+    # Full restart: stores wiped-and-recreated, fences persist, leader
+    # epoch resets to the default [1, ""].
+    cl2 = Cluster(tmp_path, n=3, rf=2)
+    assert cl2.leader.epoch == [1, ""]
+
+    # Promotion-style re-fence discovers the persisted fences and adopts.
+    adopted = cl2.leader.fence_members()
+    assert adopted[0] > 7
+    # Writes flow again end to end under the adopted term.
+    c = cl2.client()
+    c.put_bytes(b"recovered", "f")
+    assert c.get_bytes("f")[1] == b"recovered"
