@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from functools import partial
@@ -445,6 +446,80 @@ def bench_flash(deadline: float | None = None) -> dict:
             "dense_ms": round(td, 2),
             "dense_over_flash": round(td / tf, 3),
         }
+
+    # Composed ring+flash path (VERDICT r4 item 5). Two artifacts:
+    # (a) on-chip: the composed schedule through shard_map on a 1-device
+    #     mesh vs the bare kernel — measures the composition overhead
+    #     (merge math + shard_map) on real hardware;
+    # (b) sp=2 memory: AOT-compile BOTH ring schedules on a virtual
+    #     2-device CPU mesh at S=8192 and record XLA's temp-memory
+    #     analysis — the committed evidence that the composed ring holds
+    #     O(S_local*blk) per step where the old ring held [S_local,
+    #     S_local] f32 scores.
+    if time_left() > 0:
+        try:
+            from dmlc_tpu.parallel.mesh import make_mesh
+            from dmlc_tpu.parallel.ring_attention import ring_flash_attention
+
+            s, h = 8192, 2
+            ks = jax.random.split(jax.random.PRNGKey(1), 3)
+            q, k, v = (jax.random.normal(x, (1, h, s, 128), jnp.bfloat16) for x in ks)
+            np.asarray(q[0, 0, 0, :2])
+            mesh1 = make_mesh({"sp": 1}, devices=jax.devices()[:1])
+            rf = jax.jit(lambda q, k, v: ring_flash_attention(q, k, v, mesh1, causal=True))
+            trf = timed(rf, (q, k, v))
+            base = out.get("s8192_h2", {}).get("flash_ms")
+            out["ring_flash_s8192"] = {
+                "composed_ms": round(trf, 2),
+                "bare_flash_ms": base,
+                "overhead": round(trf / base, 3) if base else None,
+            }
+        except Exception as e:
+            print(f"[bench-flash] ring_flash FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+    if time_left() > 0:
+        try:
+            import subprocess as sp
+
+            script = (
+                "import jax, json, importlib\n"
+                "jax.config.update('jax_platforms', 'cpu')\n"
+                "import jax.numpy as jnp\n"
+                "from dmlc_tpu.parallel.mesh import make_mesh\n"
+                # importlib: the package re-exports a FUNCTION named
+                # ring_attention that shadows the submodule attribute.
+                "ra = importlib.import_module('dmlc_tpu.parallel.ring_attention')\n"
+                "mesh = make_mesh({'sp': 2})\n"
+                "q = jnp.zeros((1, 1, 8192, 128), jnp.bfloat16)\n"
+                "res = {}\n"
+                "for name, fn in (('ring_dense_accum', ra.ring_attention),"
+                " ('ring_flash', ra.ring_flash_attention)):\n"
+                "    c = jax.jit(lambda q, k, v: fn(q, k, v, mesh, causal=True))"
+                ".lower(q, q, q).compile()\n"
+                "    m = c.memory_analysis()\n"
+                "    res[name] = int(getattr(m, 'temp_size_in_bytes', 0))\n"
+                "print(json.dumps(res))\n"
+            )
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+            r = sp.run(
+                [sys.executable, "-c", script],
+                capture_output=True, text=True, timeout=max(10.0, time_left()),
+                env=env, cwd=str(Path(__file__).parent),
+            )
+            if r.returncode != 0 or not r.stdout.strip():
+                raise RuntimeError(
+                    f"subprocess rc={r.returncode}: {r.stderr.strip()[-500:]}"
+                )
+            mem = json.loads(r.stdout.strip().splitlines()[-1])
+            dense_t, flash_t = mem["ring_dense_accum"], mem["ring_flash"]
+            out["sp2_memory_s8192"] = {
+                "ring_dense_accum_temp_bytes": dense_t,
+                "ring_flash_temp_bytes": flash_t,
+                "flash_over_dense": round(flash_t / dense_t, 3) if dense_t else None,
+            }
+        except Exception as e:
+            print(f"[bench-flash] sp2 memory FAILED: {type(e).__name__}: {e}", file=sys.stderr)
     return out
 
 
@@ -737,7 +812,7 @@ def main() -> None:
         "headline": 150.0,
         "secondary": 75.0,
         "e2e": 90.0,
-        "flash": 60.0,
+        "flash": 110.0,  # incl. the sp=2 CPU-subprocess memory analysis
         "curve_point": 30.0,
         "train": 100.0,
     }
@@ -888,11 +963,14 @@ def main() -> None:
         try:
             flash = bench_flash(deadline=time.monotonic() + CAPS["flash"])
             for key, r in flash.items():
-                print(
-                    f"[bench-flash] {key}: flash {r['flash_ms']}ms "
-                    f"dense {r['dense_ms']}ms ratio {r['dense_over_flash']}x",
-                    file=sys.stderr,
-                )
+                if "flash_ms" in r:
+                    line = (
+                        f"flash {r['flash_ms']}ms dense {r['dense_ms']}ms "
+                        f"ratio {r['dense_over_flash']}x"
+                    )
+                else:  # composed-path entries carry their own fields
+                    line = " ".join(f"{k}={v}" for k, v in r.items())
+                print(f"[bench-flash] {key}: {line}", file=sys.stderr)
         except Exception as e:
             print(f"[bench-flash] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
 
@@ -923,13 +1001,16 @@ def main() -> None:
                 if over_budget(f"curve {model}@{bs}"):
                     continue
                 try:
+                    # passes=2: single-pass curve points proved too noisy to
+                    # commit (one slow-host window wrote a 2.9x-low
+                    # resnet18@512 into the artifact as clean data).
                     r = bench_model(
                         model,
                         bs,
                         seconds=1.5,
-                        passes=1,
+                        passes=2,
                         latency_iters=0,
-                        max_passes=1,
+                        max_passes=2,
                         deadline=time.monotonic() + CAPS["curve_point"],
                     )
                 except Exception as e:
@@ -942,7 +1023,19 @@ def main() -> None:
                 "batch_size": bs,
                 "images_per_sec_per_chip": r["images_per_sec_per_chip"],
             }
-            if r.get("degraded_vs_history") or degraded_vs_best(r, history_best):
+            # Curve points use a TIGHTER 2x threshold than the configs' 3x:
+            # they are quick two-pass measurements with no latency loop, so
+            # a transient window can sit well under best-known without
+            # tripping the 3x guard (round 4: a 2.9x-low resnet18@512
+            # landed in the committed artifact as clean data).
+            best = history_best.get(f"{r['model']}@{r['batch_size']}")
+            curve_low = bool(
+                best
+                and best.get("images_per_sec_per_chip")
+                and r["images_per_sec_per_chip"]
+                < best["images_per_sec_per_chip"] / 2.0
+            )
+            if r.get("degraded_vs_history") or curve_low:
                 entry["degraded_vs_history"] = True
             curve.setdefault(model, []).append(entry)
         for model, pts in curve.items():

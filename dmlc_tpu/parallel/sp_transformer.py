@@ -25,12 +25,15 @@ from jax.sharding import Mesh
 from dmlc_tpu.parallel.ring_attention import dense_attention, ring_attention
 from dmlc_tpu.parallel.ulysses import ulysses_attention
 
-_SCHEDULES = ("ring", "ulysses", "dense", "flash")
+_SCHEDULES = ("ring", "ring_flash", "ulysses", "dense", "flash")
 
 
 class SPSelfAttention(nn.Module):
     """Multi-head self-attention over a sequence sharded on ``mesh``'s sp
-    axis. ``schedule`` picks the communication pattern: "ring" (ppermute
+    axis. ``schedule`` picks the communication pattern: "ring_flash"
+    (ppermute K/V rotation with the pallas flash kernel as the per-step
+    accumulator — O(S_local * blk) memory, no [S_local, S_local] scores in
+    forward or backward), "ring" (ppermute
     K/V rotation, O(S/n) memory, no head constraint), "ulysses" (all-to-all
     head/sequence reshard, needs heads % sp == 0), "dense" (no sp —
     single-device reference semantics, used for parity tests), or "flash"
@@ -59,6 +62,10 @@ class SPSelfAttention(nn.Module):
         q, k, v = heads("query"), heads("key"), heads("value")
         if self.schedule == "ring":
             o = ring_attention(q, k, v, self.mesh, causal=self.causal)
+        elif self.schedule == "ring_flash":
+            from dmlc_tpu.parallel.ring_attention import ring_flash_attention
+
+            o = ring_flash_attention(q, k, v, self.mesh, causal=self.causal)
         elif self.schedule == "ulysses":
             o = ulysses_attention(q, k, v, self.mesh, causal=self.causal)
         elif self.schedule == "flash":

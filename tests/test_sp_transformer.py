@@ -40,7 +40,7 @@ def test_schedules_agree_inside_the_model(setup):
     want = np.asarray(build(None, "dense").apply(variables, tokens))
     shd = NamedSharding(mesh, P("dp", "sp"))
     tokens_sharded = jax.device_put(tokens, shd)
-    for schedule in ("ring", "ulysses"):
+    for schedule in ("ring", "ring_flash", "ulysses"):
         model = build(mesh, schedule)
         got = np.asarray(jax.jit(model.apply)(variables, tokens_sharded))
         np.testing.assert_allclose(got, want, atol=3e-5, rtol=1e-4)
@@ -89,7 +89,7 @@ def test_trains_with_flash_schedule(setup):
     assert losses[-1] < losses[0]
 
 
-@pytest.mark.parametrize("schedule", ["ring", "ulysses"])
+@pytest.mark.parametrize("schedule", ["ring", "ring_flash", "ulysses"])
 def test_trains_sequence_parallel(setup, schedule):
     """Next-token LM training with sequence sharded over sp: loss must
     decrease on a fixed batch, grads stay finite, all under one jit."""
