@@ -81,25 +81,55 @@ def _time_left(deadline: float | None) -> float:
     return float("inf") if deadline is None else deadline - time.monotonic()
 
 
-def degraded_vs_best(r: dict, history_best: dict) -> bool:
-    """True when a measurement is >3x off the best this (model, batch) has
-    ever recorded — the signature of a degraded tunnel window (round 3: every
-    model landed at ~1/20th of its known rate and the artifact recorded the
-    garbage with no annotation), not of ordinary ±5-10% wobble."""
+def degraded_vs_best(r: dict, history_best: dict, factor: float = 3.0) -> bool:
+    """True when a measurement is >``factor``x off the best this
+    (model, batch) has ever recorded — the signature of a degraded tunnel
+    window (round 3: every model landed at ~1/20th of its known rate and the
+    artifact recorded the garbage with no annotation), not of ordinary
+    ±5-10% wobble. Configs use the default 3x; quick curve points (no
+    latency loop) use a tighter 2x."""
     best = history_best.get(f"{r.get('model')}@{r.get('batch_size')}")
     if not best:
         return False
     slow_lat = (
         bool(r.get("p50_ms"))
         and bool(best.get("p50_ms"))
-        and r["p50_ms"] > 3.0 * best["p50_ms"]
+        and r["p50_ms"] > factor * best["p50_ms"]
     )
     ips = r.get("images_per_sec_per_chip") or 0.0
     slow_thr = (
         bool(best.get("images_per_sec_per_chip"))
-        and ips < best["images_per_sec_per_chip"] / 3.0
+        and ips < best["images_per_sec_per_chip"] / factor
     )
     return slow_lat or slow_thr
+
+
+def annotate_flash_entries(flash: dict, old_flash: dict) -> dict:
+    """Per-entry degradation guard for the flash microbench, mirroring the
+    configs'/curve's history protection: each timed entry tracks its
+    best-known (MINIMUM) flash/dense ms, and a reading >2x its best is
+    flagged so merge_detail keeps the previous healthy entry — one noisy
+    20-iter window must not commit a 'flash 1.45x slower than dense'
+    artifact the kernel docstring cites as parity evidence (review r4)."""
+    out = {}
+    for key, r in flash.items():
+        r = dict(r)
+        prev = old_flash.get(key) or {}
+        degraded = False
+        for leg in ("flash_ms", "dense_ms"):
+            cur = r.get(leg)
+            if cur is None:
+                continue
+            best = min(
+                x for x in (cur, prev.get(f"best_{leg}"), prev.get(leg)) if x
+            )
+            r[f"best_{leg}"] = round(best, 2)
+            if cur > 2.0 * best:
+                degraded = True
+        if degraded:
+            r["degraded_vs_history"] = True
+        out[key] = r
+    return out
 
 
 def update_history_best(history_best: dict, results: list[dict]) -> dict:
@@ -230,7 +260,17 @@ def merge_detail(new: dict, old: dict) -> dict:
         new_sec = {k: v for k, v in (new.get(key) or {}).items() if isinstance(v, dict)}
         old_sec = {k: v for k, v in (old.get(key) or {}).items() if isinstance(v, dict)}
         merged = {k: dict(v, stale=True) for k, v in old_sec.items()}
-        merged.update(new_sec)
+        for k, v in new_sec.items():
+            prev = old_sec.get(k)
+            # Like configs/curve: a degraded-window reading never replaces
+            # a healthy committed entry.
+            if (
+                v.get("degraded_vs_history")
+                and prev is not None
+                and not prev.get("degraded_vs_history")
+            ):
+                continue
+            merged[k] = v
         out[key] = merged if merged else (new.get(key) or {})
 
     out["history_best"] = update_history_best(
@@ -331,27 +371,33 @@ def bench_model(
     # and the chip-side rate is the max, not the mean.
     def one_pass() -> float:
         """One throughput pass, pipelined in chunks so the clock is checked
-        mid-pass WITHOUT draining the device queue: the next chunk is always
-        dispatched before the previous one is synced, so the device never
-        idles — but a tunnel that degrades 20x mid-pass (round-3 weather)
-        costs ~2 chunks, not one 17-minute block_until_ready on the whole
-        pass. Returns the elapsed time normalized to `iters` batches."""
-        chunk = max(1, iters // 8)
+        mid-pass WITHOUT starving the device queue. Chunks are TIME-based
+        (~0.5 s of estimated compute each) and the pipeline keeps 3 chunks
+        in flight before each sync: over the remote tunnel a sync costs a
+        full RTT, and a shallow pipeline of tiny chunks measurably halved
+        short configs (round 4: iters//8 chunking read resnet18@512 at 9k
+        instead of 20k+). A tunnel that degrades 20x mid-pass still costs
+        only the in-flight chunks — bounded seconds, not one unbounded
+        block_until_ready on the whole pass (round-3 weather). Returns the
+        elapsed time normalized to `iters` batches."""
+        chunk = max(1, min(iters, int(0.5 / max(per_batch, 1e-4))))
+        depth = 3
         t_start = time.perf_counter()
-        prev: list | None = None
+        in_flight: list[list] = []
         done = 0
         for s in range(0, iters, chunk):
             cur = [
                 engine._forward(engine.variables, bufs[i % n_bufs])
                 for i in range(s, min(s + chunk, iters))
             ]
+            in_flight.append(cur)
             done = s + len(cur)
-            if prev is not None:
-                jax.block_until_ready(prev)
+            if len(in_flight) > depth:
+                jax.block_until_ready(in_flight.pop(0))
                 if time_left() < 0:
                     break
-            prev = cur
-        jax.block_until_ready(cur)
+        for c in in_flight:
+            jax.block_until_ready(c)
         return (time.perf_counter() - t_start) * iters / done
 
     elapsed_list: list[float] = []
@@ -481,18 +527,17 @@ def bench_flash(deadline: float | None = None) -> dict:
             import subprocess as sp
 
             script = (
-                "import jax, json, importlib\n"
+                "import jax, json\n"
                 "jax.config.update('jax_platforms', 'cpu')\n"
                 "import jax.numpy as jnp\n"
                 "from dmlc_tpu.parallel.mesh import make_mesh\n"
-                # importlib: the package re-exports a FUNCTION named
-                # ring_attention that shadows the submodule attribute.
-                "ra = importlib.import_module('dmlc_tpu.parallel.ring_attention')\n"
+                "from dmlc_tpu.parallel.ring_attention import ("
+                "ring_attention, ring_flash_attention)\n"
                 "mesh = make_mesh({'sp': 2})\n"
                 "q = jnp.zeros((1, 1, 8192, 128), jnp.bfloat16)\n"
                 "res = {}\n"
-                "for name, fn in (('ring_dense_accum', ra.ring_attention),"
-                " ('ring_flash', ra.ring_flash_attention)):\n"
+                "for name, fn in (('ring_dense_accum', ring_attention),"
+                " ('ring_flash', ring_flash_attention)):\n"
                 "    c = jax.jit(lambda q, k, v: fn(q, k, v, mesh, causal=True))"
                 ".lower(q, q, q).compile()\n"
                 "    m = c.memory_analysis()\n"
@@ -780,7 +825,7 @@ def main() -> None:
     parser.add_argument(
         "--budget-s",
         type=float,
-        default=300.0,
+        default=420.0,
         help="wall-clock budget: a secondary config or the e2e section only "
         "STARTS while under this, so with the slowest single item (~4 min "
         "of compile+run on a degraded tunnel) the whole run still exits "
@@ -961,7 +1006,10 @@ def main() -> None:
     flash = {}
     if not over_budget("flash"):
         try:
-            flash = bench_flash(deadline=time.monotonic() + CAPS["flash"])
+            flash = annotate_flash_entries(
+                bench_flash(deadline=time.monotonic() + CAPS["flash"]),
+                prev_detail.get("flash") or {},
+            )
             for key, r in flash.items():
                 if "flash_ms" in r:
                     line = (
@@ -975,7 +1023,7 @@ def main() -> None:
             print(f"[bench-flash] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
 
     # Batch curve: the data behind batch_overrides. Every point is
-    # budget-gated individually, quick (no latency loop, single pass), and
+    # budget-gated individually, quick (no latency loop, best-of-2), and
     # ordered so the points that inform the defaults land first. With a warm
     # compile cache the whole sweep is ~1 min; cold points self-skip via the
     # budget. Points already measured as configs are reused, not re-run.
@@ -1028,14 +1076,9 @@ def main() -> None:
             # a transient window can sit well under best-known without
             # tripping the 3x guard (round 4: a 2.9x-low resnet18@512
             # landed in the committed artifact as clean data).
-            best = history_best.get(f"{r['model']}@{r['batch_size']}")
-            curve_low = bool(
-                best
-                and best.get("images_per_sec_per_chip")
-                and r["images_per_sec_per_chip"]
-                < best["images_per_sec_per_chip"] / 2.0
-            )
-            if r.get("degraded_vs_history") or curve_low:
+            if r.get("degraded_vs_history") or degraded_vs_best(
+                r, history_best, factor=2.0
+            ):
                 entry["degraded_vs_history"] = True
             curve.setdefault(model, []).append(entry)
         for model, pts in curve.items():

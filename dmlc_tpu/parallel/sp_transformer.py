@@ -22,7 +22,11 @@ import flax.linen as nn
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from dmlc_tpu.parallel.ring_attention import dense_attention, ring_attention
+from dmlc_tpu.parallel.ring_attention import (
+    dense_attention,
+    ring_attention,
+    ring_flash_attention,
+)
 from dmlc_tpu.parallel.ulysses import ulysses_attention
 
 _SCHEDULES = ("ring", "ring_flash", "ulysses", "dense", "flash")
@@ -63,8 +67,6 @@ class SPSelfAttention(nn.Module):
         if self.schedule == "ring":
             o = ring_attention(q, k, v, self.mesh, causal=self.causal)
         elif self.schedule == "ring_flash":
-            from dmlc_tpu.parallel.ring_attention import ring_flash_attention
-
             o = ring_flash_attention(q, k, v, self.mesh, causal=self.causal)
         elif self.schedule == "ulysses":
             o = ulysses_attention(q, k, v, self.mesh, causal=self.causal)

@@ -272,3 +272,40 @@ def test_bench_py_compiles():
         [sys.executable, "-m", "py_compile", str(bench.Path(bench.__file__))],
         check=True,
     )
+
+
+class TestFlashEntryGuard:
+    def test_best_tracking_and_degraded_flag(self):
+        old = {"s2048_h8": {"flash_ms": 8.65, "dense_ms": 4.83, "best_flash_ms": 3.19,
+                            "best_dense_ms": 4.83}}
+        # Healthy new reading: advances best, no flag.
+        out = bench.annotate_flash_entries(
+            {"s2048_h8": {"flash_ms": 3.0, "dense_ms": 5.0, "dense_over_flash": 1.67}}, old
+        )
+        e = out["s2048_h8"]
+        assert e["best_flash_ms"] == 3.0 and "degraded_vs_history" not in e
+        # A >2x-off-best reading is flagged and never advances the record.
+        out = bench.annotate_flash_entries(
+            {"s2048_h8": {"flash_ms": 8.65, "dense_ms": 4.9}}, old
+        )
+        e = out["s2048_h8"]
+        assert e["degraded_vs_history"] is True and e["best_flash_ms"] == 3.19
+
+    def test_no_history_never_flags(self):
+        out = bench.annotate_flash_entries({"s8192_h2": {"flash_ms": 9.9, "dense_ms": 9.0}}, {})
+        assert "degraded_vs_history" not in out["s8192_h2"]
+        assert out["s8192_h2"]["best_flash_ms"] == 9.9
+
+    def test_untimed_entries_pass_through(self):
+        out = bench.annotate_flash_entries(
+            {"sp2_memory_s8192": {"ring_flash_temp_bytes": 14911496}}, {}
+        )
+        assert out["sp2_memory_s8192"] == {"ring_flash_temp_bytes": 14911496}
+
+    def test_merge_keeps_healthy_entry_over_degraded(self):
+        old = {"configs": [], "flash": {"s2048_h8": {"flash_ms": 3.19, "dense_ms": 5.0}}}
+        new = {"configs": [], "flash": {"s2048_h8": {"flash_ms": 8.65, "dense_ms": 4.9,
+                                                     "degraded_vs_history": True}}}
+        out = bench.merge_detail(new, old)
+        assert out["flash"]["s2048_h8"]["flash_ms"] == 3.19
+        assert out["flash"]["s2048_h8"]["stale"] is True

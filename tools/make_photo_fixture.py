@@ -1,0 +1,164 @@
+"""Generate the committed photographic-JPEG fixture (tests/fixtures/photos).
+
+The reference ships real ImageNet JPEGs (`test_files/imagenet_1k/`) so its
+decode->normalize->forward pipeline is exercised on real photographic data
+(services.rs:492). This environment has zero network egress, so committing
+photographs is impossible; instead this script synthesizes scenes with
+photographic STATISTICS — smooth illumination gradients, multi-octave
+texture, anti-aliased object boundaries, specular highlights, full-range
+chroma — and encodes them as real JPEGs (quality 87, 4:2:0 chroma
+subsampling), so the committed bytes carry genuine DCT blocks, quantization
+noise, and subsampled chroma: everything a decoder disagreement would show
+up in.
+
+Deterministic: fixed seeds, PIL encoder. The fixture is committed as BYTES;
+tests decode the committed files and never regenerate them, so a PIL
+version bump cannot silently move the goalposts. Regenerate only
+deliberately:  python tools/make_photo_fixture.py
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+OUT_DIR = Path(__file__).resolve().parent.parent / "tests" / "fixtures" / "photos"
+
+
+def _value_noise(rng: np.random.Generator, h: int, w: int, octaves: int = 5) -> np.ndarray:
+    """Multi-octave value noise in [0, 1] — the 1/f-ish texture spectrum of
+    natural surfaces (grass, rock, fabric)."""
+    out = np.zeros((h, w), np.float32)
+    amp, total = 1.0, 0.0
+    for o in range(octaves):
+        step = max(2, 2 ** (octaves - o + 1))
+        gh, gw = h // step + 2, w // step + 2
+        grid = rng.random((gh, gw), dtype=np.float32)
+        ys = np.linspace(0, gh - 2, h, dtype=np.float32)
+        xs = np.linspace(0, gw - 2, w, dtype=np.float32)
+        y0, x0 = ys.astype(int), xs.astype(int)
+        fy, fx = (ys - y0)[:, None], (xs - x0)[None, :]
+        a = grid[y0][:, x0]
+        b = grid[y0][:, x0 + 1]
+        c = grid[y0 + 1][:, x0]
+        d = grid[y0 + 1][:, x0 + 1]
+        out += amp * ((a * (1 - fx) + b * fx) * (1 - fy) + (c * (1 - fx) + d * fx) * fy)
+        total += amp
+        amp *= 0.55
+    return out / total
+
+
+def _scene_landscape(h=480, w=640) -> np.ndarray:
+    rng = np.random.default_rng(11)
+    y = np.linspace(0, 1, h, dtype=np.float32)[:, None]
+    x = np.linspace(0, 1, w, dtype=np.float32)[None, :]
+    # Sky: blue->warm gradient with a sun disc.
+    sky = np.stack(
+        [0.35 + 0.45 * y, 0.55 + 0.25 * y, 0.95 - 0.25 * y], -1
+    ) * np.ones((h, w, 1), np.float32)
+    sun = np.exp(-(((x - 0.72) ** 2 + (y - 0.22) ** 2) / 0.004))
+    sky += sun[..., None] * np.array([0.6, 0.5, 0.2], np.float32)
+    # Mountain silhouette.
+    ridge = 0.45 + 0.08 * np.sin(x[0] * 9.3) + 0.05 * np.sin(x[0] * 23.7 + 1.0)
+    mountain_mask = (y > ridge[None, :]).astype(np.float32)
+    rock = _value_noise(rng, h, w)[..., None] * 0.25 + 0.15
+    img = sky * (1 - mountain_mask[..., None]) + rock * mountain_mask[..., None]
+    # Foreground grass band with fine texture.
+    grass_mask = (y > 0.72).astype(np.float32)[..., None]
+    grass = np.stack(
+        [
+            0.15 + 0.2 * _value_noise(rng, h, w),
+            0.35 + 0.3 * _value_noise(rng, h, w),
+            0.10 + 0.1 * _value_noise(rng, h, w),
+        ],
+        -1,
+    )
+    img = img * (1 - grass_mask) + grass * grass_mask
+    return img
+
+
+def _scene_macro(h=384, w=512) -> np.ndarray:
+    rng = np.random.default_rng(23)
+    yy = np.linspace(-1, 1, h, dtype=np.float32)[:, None]
+    xx = np.linspace(-1, 1, w, dtype=np.float32)[None, :]
+    img = np.full((h, w, 3), 0.08, np.float32)
+    # Soft bokeh-like color blobs.
+    for _ in range(14):
+        cx, cy = rng.uniform(-1, 1, 2)
+        r = rng.uniform(0.08, 0.4)
+        col = rng.uniform(0.2, 1.0, 3).astype(np.float32)
+        g = np.exp(-(((xx - cx) ** 2 + (yy - cy) ** 2) / (r * r)))
+        img += g[..., None] * col * 0.35
+    img += (_value_noise(rng, h, w)[..., None] - 0.5) * 0.08  # sensor-ish grain
+    return img
+
+
+def _scene_night(h=480, w=640) -> np.ndarray:
+    rng = np.random.default_rng(37)
+    img = np.full((h, w, 3), 0.02, np.float32)
+    yy = np.arange(h, dtype=np.float32)[:, None]
+    xx = np.arange(w, dtype=np.float32)[None, :]
+    for _ in range(60):  # street lights / stars with glow
+        cx, cy = rng.uniform(0, w), rng.uniform(0, h * 0.6)
+        warm = rng.random() < 0.5
+        col = np.array([1.0, 0.85, 0.55] if warm else [0.7, 0.8, 1.0], np.float32)
+        sigma = rng.uniform(1.0, 6.0)
+        g = np.exp(-(((xx - cx) ** 2 + (yy - cy) ** 2) / (2 * sigma * sigma)))
+        img += g[..., None] * col * rng.uniform(0.3, 1.0)
+    # Dim skyline blocks.
+    for _ in range(8):
+        x0 = int(rng.uniform(0, w - 60))
+        bw, bh = int(rng.uniform(30, 90)), int(rng.uniform(60, 180))
+        img[h - bh :, x0 : x0 + bw] *= 0.3
+        img[h - bh :, x0 : x0 + bw] += 0.04
+    return img
+
+
+def _scene_interior(h=512, w=512) -> np.ndarray:
+    rng = np.random.default_rng(53)
+    y = np.linspace(0, 1, h, dtype=np.float32)[:, None]
+    x = np.linspace(0, 1, w, dtype=np.float32)[None, :]
+    # Perspective checkerboard floor under warm light.
+    depth = np.clip((y - 0.45) * 2.2, 1e-3, None)
+    u = (x - 0.5) / depth * 3.0
+    v = 1.0 / depth
+    checker = ((np.floor(u) + np.floor(v)) % 2).astype(np.float32)
+    floor = (0.25 + 0.5 * checker)[..., None] * np.array([0.8, 0.6, 0.45], np.float32)
+    wall = np.stack([0.55 - 0.2 * y, 0.5 - 0.2 * y, 0.48 - 0.15 * y], -1) * np.ones_like(x)[..., None]
+    img = np.where((y > 0.45)[..., None] * np.ones_like(floor, bool), floor, wall)
+    # A matte red ball with a specular highlight, anti-aliased edge.
+    cy_, cx_, r = 0.62, 0.38, 0.13
+    d = np.sqrt((x - cx_) ** 2 + (y - cy_) ** 2)
+    edge = np.clip((r - d) / 0.004, 0.0, 1.0)[..., None]
+    shade = np.clip(1.2 - d / r, 0.2, 1.0)[..., None]
+    ball = shade * np.array([0.75, 0.12, 0.1], np.float32)
+    spec = np.exp(-(((x - cx_ + 0.04) ** 2 + (y - cy_ - 0.05) ** 2) / 0.0006))[..., None]
+    ball = ball + spec * 0.7
+    img = img * (1 - edge) + ball * edge
+    img += (_value_noise(rng, h, w)[..., None] - 0.5) * 0.05
+    return img
+
+
+SCENES = {
+    "landscape_640x480.jpg": _scene_landscape,
+    "macro_512x384.jpg": _scene_macro,
+    "night_640x480.jpg": _scene_night,
+    "interior_512x512.jpg": _scene_interior,
+}
+
+
+def main() -> None:
+    from PIL import Image
+
+    OUT_DIR.mkdir(parents=True, exist_ok=True)
+    for name, fn in SCENES.items():
+        img = np.clip(fn(), 0.0, 1.0)
+        u8 = (img * 255.0 + 0.5).astype(np.uint8)
+        path = OUT_DIR / name
+        Image.fromarray(u8).save(path, "JPEG", quality=87, subsampling=2)
+        print(f"{path} {path.stat().st_size} bytes")
+
+
+if __name__ == "__main__":
+    main()
