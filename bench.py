@@ -168,6 +168,11 @@ def merge_detail(new: dict, old: dict) -> dict:
     for key in ("captured_at", "degraded_tunnel", "roofline_notes"):
         if new.get(key) is not None:
             out[key] = new[key]
+    # A partial/manual merge without the notes must not drop them from the
+    # artifact (round 4: a flash-only refresh silently lost the section
+    # README cites).
+    if "roofline_notes" not in out and old.get("roofline_notes"):
+        out["roofline_notes"] = old["roofline_notes"]
 
     # Configs key by (model, batch) like history_best: a --batch-size 256
     # fallback run must not erase the committed batch-1024 headline row.

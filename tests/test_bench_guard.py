@@ -226,6 +226,17 @@ class TestMergeDetail:
         out2 = bench.merge_detail({"configs": [_cfg()]}, out)
         assert "degraded_tunnel" not in out2
 
+    def test_partial_merge_keeps_roofline_notes(self):
+        # A flash-only/manual merge without the notes must not drop them.
+        old = dict(self.OLD, roofline_notes={"vit_b16": "bound note"})
+        out = bench.merge_detail({"configs": [_cfg()]}, old)
+        assert out["roofline_notes"] == {"vit_b16": "bound note"}
+        # A run that DOES carry notes refreshes them.
+        out2 = bench.merge_detail(
+            {"configs": [], "roofline_notes": {"vit_b16": "new"}}, old
+        )
+        assert out2["roofline_notes"] == {"vit_b16": "new"}
+
     def test_empty_old_artifact(self):
         new = {"configs": [_cfg()], "e2e": None, "flash": {}, "train": {}}
         out = bench.merge_detail(new, {})
@@ -260,7 +271,8 @@ def test_committed_artifact_has_all_sections_and_history():
     """The committed artifact must never again lose sections README/PARITY
     cite: every section present and non-empty, history_best populated."""
     detail = json.loads((bench.Path(__file__).parents[1] / "bench_detail.json").read_text())
-    for key in ("configs", "e2e", "batch_curve", "flash", "train", "history_best"):
+    for key in ("configs", "e2e", "batch_curve", "flash", "train", "history_best",
+                "roofline_notes"):
         assert detail.get(key), f"bench_detail.json[{key!r}] missing or empty"
     assert detail["history_best"].get("resnet18@1024", {}).get(
         "images_per_sec_per_chip", 0
