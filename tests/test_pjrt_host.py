@@ -133,6 +133,37 @@ class TestBundleExporter:
         ) + sum(p.stat().st_size for p in out.glob("arg*.raw"))
         assert info["program_bytes"] < max(200_000, weight_bytes)
 
+    def test_image_staging(self, tmp_path):
+        """--image decodes real JPEGs into the staged input batch: the
+        manifest's image line references image.raw with exact batch bytes,
+        padded by repetition to the export batch size."""
+        import tiny_model  # noqa: F401
+
+        from tools.export_pjrt_bundle import export_bundle
+
+        photos = sorted(
+            str(p) for p in (Path(__file__).parent / "fixtures" / "photos").glob("*.jpg")
+        )
+        out = tmp_path / "b"
+        export_bundle("tinynet", 8, out, image_paths=photos[:3])  # pads 3 -> 8
+        lines = (out / "args.txt").read_text().splitlines()
+        image_lines = [l for l in lines if l.endswith("=image.raw")]
+        assert len(image_lines) == 1
+        dt, _, rest = image_lines[0].partition(":")
+        dims = [int(d) for d in rest.split("=")[0].split(",")]
+        assert dims[0] == 8 and dt == "u8"
+        import numpy as np
+
+        want = int(np.prod(dims))
+        assert (out / "image.raw").stat().st_size == want
+        raw = np.frombuffer((out / "image.raw").read_bytes(), np.uint8).reshape(dims)
+        # Repetition padding: row 3 repeats row 0; real pixels, not zeros.
+        np.testing.assert_array_equal(raw[3], raw[0])
+        assert raw.std() > 10
+        # Overflowing the batch fails loudly instead of dropping photos.
+        with pytest.raises(ValueError, match="silently"):
+            export_bundle("tinynet", 2, tmp_path / "b2", image_paths=photos[:3])
+
     def test_compile_options_deserializable(self, bundle):
         out, _ = bundle
         from jax._src.lib import xla_client

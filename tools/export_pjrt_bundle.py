@@ -49,7 +49,13 @@ def axon_client_options() -> str:
     )
 
 
-def export_bundle(model_name: str, batch_size: int, out_dir: Path, seed: int = 0) -> dict:
+def export_bundle(
+    model_name: str,
+    batch_size: int,
+    out_dir: Path,
+    seed: int = 0,
+    image_paths: list[str] | None = None,
+) -> dict:
     import jax
     import numpy as np
 
@@ -81,7 +87,32 @@ def export_bundle(model_name: str, batch_size: int, out_dir: Path, seed: int = 0
             raise ValueError(f"unsupported exported input dtype {aval.dtype}")
         shape = ",".join(str(d) for d in aval.shape)
         if str(aval.dtype) == "uint8" and len(aval.shape) == 4:
-            lines.append(f"{dt}:{shape}")  # the image batch: zeros or staged
+            if image_paths:
+                # Stage REAL decoded pixels so the native host classifies
+                # actual JPEG data, not zeros; pad the batch by repeating.
+                from dmlc_tpu.ops import preprocess as pp
+
+                if len(image_paths) > batch_size:
+                    raise ValueError(
+                        f"{len(image_paths)} images but batch size "
+                        f"{batch_size}: the extras would be silently "
+                        "dropped — raise --batch or trim --image"
+                    )
+                size = int(aval.shape[1])
+                batch = pp.load_batch(image_paths, size=size)
+                reps = -(-batch_size // batch.shape[0])
+                batch = np.tile(batch, (reps, 1, 1, 1))[:batch_size]
+                if tuple(batch.shape) != tuple(aval.shape):
+                    # Mirrors the weight-leaf guard: fail at export time,
+                    # not at the host's deploy-time byte-size check.
+                    raise ValueError(
+                        f"staged image batch {batch.shape} != exported "
+                        f"input aval {tuple(aval.shape)}"
+                    )
+                (out_dir / "image.raw").write_bytes(batch.tobytes())
+                lines.append(f"{dt}:{shape}=image.raw")
+            else:
+                lines.append(f"{dt}:{shape}")  # the image batch: zeros
         else:
             leaf = np.asarray(flat_vars[n_weight_args])
             if tuple(leaf.shape) != tuple(aval.shape):
@@ -115,8 +146,16 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--out", required=True)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--image", action="append", default=None,
+        help="JPEG(s) to decode into the staged input batch (repeatable); "
+        "default: zeros",
+    )
     args = ap.parse_args()
-    info = export_bundle(args.model, args.batch, Path(args.out), seed=args.seed)
+    info = export_bundle(
+        args.model, args.batch, Path(args.out), seed=args.seed,
+        image_paths=args.image,
+    )
     print(info)
 
 
