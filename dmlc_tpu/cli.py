@@ -58,6 +58,9 @@ Commands (reference: README.md:10-23):
   train | t                             broadcast model weights to members
   predict                               start/resume the inference jobs
   export <model>                        publish the model's StableHLO executable
+  export-bundle <model> <dir>           write the native PJRT host bundle
+                                        (program.mlir + weights + manifests;
+                                        served by native/pjrt_host, no Python)
   mesh-join                             join the fleet-wide jax.distributed mesh
   jobs                                  job status, accuracy, latency percentiles
   assign                                per-job member assignment table
@@ -166,6 +169,32 @@ class Cli:
                 n.sdfs, args[0], batch_size=n.config.batch_size
             )
             return f"exported {args[0]} -> {export_lib.sdfs_executable_name(args[0])} v{v}"
+        if cmd == "export-bundle":
+            if len(args) != 2:
+                return "usage: export-bundle <model_name> <out_dir>"
+            from pathlib import Path
+
+            from dmlc_tpu.models import weights as weights_lib
+            from dmlc_tpu.models.pjrt_bundle import export_bundle
+
+            # Bundle the cluster's PUBLISHED weights when they exist (the
+            # same blob the Python serving path trains/hot-swaps from);
+            # random init only for clusters that never published any.
+            variables, source = None, "random-init (no published weights)"
+            try:
+                _, blob = n.sdfs.get_bytes(weights_lib.sdfs_weights_name(args[0]))
+                _, variables = weights_lib.weights_from_bytes(blob, expect_model=args[0])
+                source = "published SDFS weights"
+            except Exception:
+                pass
+            info = export_bundle(
+                args[0], n.config.batch_size, Path(args[1]), variables=variables
+            )
+            return (
+                f"bundle for {info['model']} (batch {info['batch']}, "
+                f"{info['weight_args']} weight files, {source}) -> {args[1]}; "
+                f"run with: native/pjrt_host run <plugin.so> {args[1]}"
+            )
         if cmd == "mesh-join":
             info = n.join_global_mesh()
             return (

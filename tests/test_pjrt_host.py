@@ -83,7 +83,7 @@ class TestBundleExporter:
     def bundle(self, tmp_path_factory):
         import tiny_model  # noqa: F401  (registers tinynet)
 
-        from tools.export_pjrt_bundle import export_bundle
+        from dmlc_tpu.models.pjrt_bundle import export_bundle
 
         out = tmp_path_factory.mktemp("bundle")
         info = export_bundle("tinynet", 4, out)
@@ -139,7 +139,7 @@ class TestBundleExporter:
         padded by repetition to the export batch size."""
         import tiny_model  # noqa: F401
 
-        from tools.export_pjrt_bundle import export_bundle
+        from dmlc_tpu.models.pjrt_bundle import export_bundle
 
         photos = sorted(
             str(p) for p in (Path(__file__).parent / "fixtures" / "photos").glob("*.jpg")
@@ -180,3 +180,58 @@ def test_makefile_clean_does_not_require_header():
     makefile = (REPO / "native" / "Makefile").read_text()
     assert "pjrt_host" in makefile
     assert shutil.which("g++")
+
+
+def test_cli_export_bundle_verb(tmp_path):
+    """The cluster CLI can produce the native host bundle (operator story:
+    export from the REPL, serve with native/pjrt_host — no Python)."""
+    import tiny_model  # noqa: F401
+
+    from dmlc_tpu.cli import Cli
+
+    class StubNode:
+        class config:
+            batch_size = 4
+
+    out = Cli(StubNode()).run_command(f"export-bundle tinynet {tmp_path / 'b'}")
+    assert "bundle for tinynet" in out and "pjrt_host run" in out
+    for name in ("program.mlir", "args.txt", "compile_options.pb", "client_options.txt"):
+        assert (tmp_path / "b" / name).exists()
+    assert "random-init" in out  # stub node has no SDFS weights
+    # And the usage path answers cleanly.
+    assert "usage:" in Cli(StubNode()).run_command("export-bundle tinynet")
+
+
+def test_cli_export_bundle_uses_published_weights(tmp_path):
+    """With weights published in SDFS, the verb bundles THOSE — the native
+    host must serve what the cluster trained, not a random init."""
+    import jax
+    import numpy as np
+    import tiny_model  # noqa: F401
+
+    from dmlc_tpu.cli import Cli
+    from dmlc_tpu.models import weights as weights_lib
+    from dmlc_tpu.models.registry import get_model
+
+    spec = get_model("tinynet")
+    _, variables = spec.init_params(jax.random.PRNGKey(42))
+    blob = weights_lib.weights_to_bytes("tinynet", variables)
+
+    class StubSdfs:
+        def get_bytes(self, name):
+            assert name == weights_lib.sdfs_weights_name("tinynet")
+            return 1, blob
+
+    class StubNode:
+        sdfs = StubSdfs()
+
+        class config:
+            batch_size = 4
+
+    out = Cli(StubNode()).run_command(f"export-bundle tinynet {tmp_path / 'b'}")
+    assert "published SDFS weights" in out
+    # A bundled leaf matches the published tree, not seed-0 init.
+    leaves = jax.tree_util.tree_leaves(variables)
+    first = np.asarray(leaves[0])
+    raw = np.frombuffer((tmp_path / "b" / "arg0.raw").read_bytes(), first.dtype)
+    np.testing.assert_array_equal(raw, first.ravel())
