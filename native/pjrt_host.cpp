@@ -298,6 +298,54 @@ int Probe(const char* so_path, const char* options_path) {
   return 0;
 }
 
+// One execution dispatch: fresh output buffers + completion event.
+PJRT_Error* DispatchExec(PJRT_LoadedExecutable* exec, PJRT_ExecuteOptions* eopts,
+                         PJRT_Buffer* const* const* arg_lists, size_t num_args,
+                         std::vector<PJRT_Buffer*>* outs, PJRT_Event** ev) {
+  PJRT_Buffer** out_lists[1] = {outs->data()};
+  PJRT_Event* evs[1] = {nullptr};
+  PJRT_LoadedExecutable_Execute_Args ea;
+  std::memset(&ea, 0, sizeof(ea));
+  ea.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  ea.executable = exec;
+  ea.options = eopts;
+  ea.argument_lists = arg_lists;
+  ea.num_devices = 1;
+  ea.num_args = num_args;
+  ea.output_lists = out_lists;
+  ea.device_complete_events = evs;
+  PJRT_Error* err = g_api->PJRT_LoadedExecutable_Execute(&ea);
+  *ev = evs[0];
+  return err;
+}
+
+void DestroyBuffers(const std::vector<PJRT_Buffer*>& bufs) {
+  for (PJRT_Buffer* b : bufs) {
+    PJRT_Buffer_Destroy_Args bd;
+    std::memset(&bd, 0, sizeof(bd));
+    bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    bd.buffer = b;
+    g_api->PJRT_Buffer_Destroy(&bd);
+  }
+}
+
+// Copy one buffer to host (true end-of-work barrier on tunnel plugins,
+// whose completion events can resolve at dispatch-ack). Returns nonzero on
+// failure; on success `host` holds the bytes.
+int ReadbackBuffer(PJRT_Buffer* buf, std::vector<char>* host) {
+  PJRT_Buffer_ToHostBuffer_Args th;
+  std::memset(&th, 0, sizeof(th));
+  th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+  th.src = buf;
+  PJRT_Error* err = g_api->PJRT_Buffer_ToHostBuffer(&th);  // size query
+  if (err) { std::fprintf(stderr, "pjrt_host: size query failed: %s\n", ErrMessage(err).c_str()); return 1; }
+  host->resize(th.dst_size);
+  th.dst = host->data();
+  err = g_api->PJRT_Buffer_ToHostBuffer(&th);
+  if (err) { std::fprintf(stderr, "pjrt_host: readback failed: %s\n", ErrMessage(err).c_str()); return 1; }
+  return AwaitEvent(th.event);
+}
+
 // One executable argument, parsed from the bundle's args.txt manifest:
 // "<dtype>:<d0>,<d1>,...[=<relative raw file>]".
 struct ArgSpec {
@@ -331,8 +379,12 @@ int Run(int argc, char** argv) {
   const char* so_path = argv[2];
   std::string bundle = argv[3];
   const char* options_path = nullptr;
-  for (int i = 4; i + 1 < argc; i += 2)
+  int iters = 1;
+  for (int i = 4; i + 1 < argc; i += 2) {
     if (std::strcmp(argv[i], "--options") == 0) options_path = argv[i + 1];
+    else if (std::strcmp(argv[i], "--iters") == 0) iters = std::atoi(argv[i + 1]);
+  }
+  if (iters < 1) iters = 1;
   std::string default_opts = bundle + "/client_options.txt";
   Options opts;
   if (!options_path) {
@@ -474,34 +526,15 @@ int Run(int argc, char** argv) {
 
   PJRT_Buffer* const* arg_lists[1] = {in_bufs.data()};
   std::vector<PJRT_Buffer*> out_list(num_outputs, nullptr);
-  PJRT_Buffer** out_lists[1] = {out_list.data()};
-  PJRT_Event* device_events[1] = {nullptr};
-
-  PJRT_LoadedExecutable_Execute_Args eargs;
-  std::memset(&eargs, 0, sizeof(eargs));
-  eargs.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
-  eargs.executable = exec;
-  eargs.options = &eopts;
-  eargs.argument_lists = arg_lists;
-  eargs.num_devices = 1;
-  eargs.num_args = in_bufs.size();
-  eargs.output_lists = out_lists;
-  eargs.device_complete_events = device_events;
-  CHECK_PJRT(g_api->PJRT_LoadedExecutable_Execute(&eargs));
-  if (AwaitEvent(device_events[0])) return 1;
+  PJRT_Event* first_ev = nullptr;
+  CHECK_PJRT(DispatchExec(exec, &eopts, arg_lists, in_bufs.size(), &out_list, &first_ev));
+  if (AwaitEvent(first_ev)) return 1;
 
   // Read back every output and report.
   std::printf("{\"outputs\": [");
   for (size_t i = 0; i < num_outputs; ++i) {
-    PJRT_Buffer_ToHostBuffer_Args thargs;
-    std::memset(&thargs, 0, sizeof(thargs));
-    thargs.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
-    thargs.src = out_list[i];
-    CHECK_PJRT(g_api->PJRT_Buffer_ToHostBuffer(&thargs));  // size query
-    std::vector<char> host(thargs.dst_size);
-    thargs.dst = host.data();
-    CHECK_PJRT(g_api->PJRT_Buffer_ToHostBuffer(&thargs));
-    if (AwaitEvent(thargs.event)) return 1;
+    std::vector<char> host;
+    if (ReadbackBuffer(out_list[i], &host)) return 1;
 
     PJRT_Buffer_ElementType_Args etargs;
     std::memset(&etargs, 0, sizeof(etargs));
@@ -530,6 +563,55 @@ int Run(int argc, char** argv) {
     g_api->PJRT_Buffer_Destroy(&bd);
   }
   std::printf("]}\n");
+
+  if (iters > 1) {
+    // Throughput: keep up to `depth` executions in flight (each Execute
+    // allocates fresh output buffers, so dispatches don't alias), await
+    // the oldest as new ones enter — the same pipelined-dispatch shape
+    // the Python bench uses, measuring chip-side rate rather than one
+    // round trip per step.
+    const int depth = 8;
+    std::vector<std::vector<PJRT_Buffer*>> pending_bufs;
+    std::vector<PJRT_Event*> pending_events;
+    auto await_oldest = [&]() -> int {
+      if (AwaitEvent(pending_events.front())) return 1;
+      pending_events.erase(pending_events.begin());
+      DestroyBuffers(pending_bufs.front());
+      pending_bufs.erase(pending_bufs.begin());
+      return 0;
+    };
+    struct timespec t0, t1;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+    for (int i = 0; i < iters; ++i) {
+      std::vector<PJRT_Buffer*> outs(num_outputs, nullptr);
+      PJRT_Event* ev = nullptr;
+      CHECK_PJRT(DispatchExec(exec, &eopts, arg_lists, in_bufs.size(), &outs, &ev));
+      pending_bufs.push_back(std::move(outs));
+      pending_events.push_back(ev);
+      if (static_cast<int>(pending_events.size()) >= depth && await_oldest())
+        return 1;
+    }
+    // Drain, then a FINAL execute whose output we read back to the host:
+    // on a remote-tunnel plugin the completion events can resolve at
+    // dispatch-ack, so only a host readback is a true end-of-work barrier
+    // (the same lesson the Python bench learned with block_until_ready).
+    while (!pending_events.empty())
+      if (await_oldest()) return 1;
+    {
+      std::vector<PJRT_Buffer*> outs(num_outputs, nullptr);
+      PJRT_Event* ev = nullptr;
+      CHECK_PJRT(DispatchExec(exec, &eopts, arg_lists, in_bufs.size(), &outs, &ev));
+      if (AwaitEvent(ev)) return 1;
+      std::vector<char> host;
+      if (ReadbackBuffer(outs[0], &host)) return 1;
+      DestroyBuffers(outs);
+    }
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    int total_iters = iters + 1;  // incl. the readback-barrier execute
+    double sec = (t1.tv_sec - t0.tv_sec) + (t1.tv_nsec - t0.tv_nsec) * 1e-9;
+    std::printf("{\"iters\": %d, \"total_s\": %.4f, \"ms_per_exec\": %.3f}\n",
+                total_iters, sec, sec * 1e3 / total_iters);
+  }
 
   for (PJRT_Buffer* b : in_bufs) {
     PJRT_Buffer_Destroy_Args bd;
@@ -560,7 +642,7 @@ int main(int argc, char** argv) {
   std::fprintf(stderr,
                "usage:\n"
                "  pjrt_host probe <plugin.so> [client_options.txt]\n"
-               "  pjrt_host run <plugin.so> <bundle_dir> [--options client_options.txt]\n"
+               "  pjrt_host run <plugin.so> <bundle_dir> [--options f] [--iters N]\n"
                "    bundle: program.mlir + compile_options.pb + args.txt manifest\n");
   return 2;
 }
