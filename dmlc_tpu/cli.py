@@ -182,19 +182,19 @@ class Cli:
             # same blob the Python serving path trains/hot-swaps from);
             # random init only for clusters that never published any.
             variables, source = None, "random-init (no published weights)"
+            blob = None
             sdfs = getattr(n, "sdfs", None)  # standalone/tool contexts: no cluster
-            try:
-                if sdfs is None:
-                    raise RpcError(f"{args[0]} not in SDFS")
-                _, blob = sdfs.get_bytes(weights_lib.sdfs_weights_name(args[0]))
-            except RpcError as e:
-                # Only NOT-FOUND means "never published"; a corrupt blob,
-                # wrong-model magic, or transient replica failure must
-                # surface, not silently bundle random weights under a
-                # false label (same consent rule as ExportedBackend).
-                if "not in SDFS" not in str(e):
-                    raise
-                blob = None
+            if sdfs is not None:
+                try:
+                    _, blob = sdfs.get_bytes(weights_lib.sdfs_weights_name(args[0]))
+                except RpcError as e:
+                    # Only NOT-FOUND means "never published"; a corrupt
+                    # blob, wrong-model magic, or transient replica failure
+                    # must surface, not silently bundle random weights
+                    # under a false label (same consent rule as
+                    # ExportedBackend).
+                    if not weights_lib.not_published(e):
+                        raise
             if blob is not None:
                 _, variables = weights_lib.weights_from_bytes(blob, expect_model=args[0])
                 source = "published SDFS weights"

@@ -347,7 +347,7 @@ class ExportedBackend:
             except RpcUnreachable:
                 raise  # transient (failover mid-fetch): retry the shard, not random-init
             except RpcError as e:
-                if "not in SDFS" not in str(e):
+                if not weights_lib.not_published(e):
                     raise  # any refusal other than not-published is not consent
                 _, variables = spec.init_params(jax.random.PRNGKey(0), dtype=jax.numpy.float32)
                 variables = jax.tree_util.tree_map(np.asarray, variables)

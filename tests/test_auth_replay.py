@@ -162,3 +162,37 @@ def test_udp_replayed_datagram_dropped():
         assert rx.rejected == 1
     finally:
         rx.close()
+
+
+class TestReplayWindowProperties:
+    """Hypothesis invariants for the replay window: under ANY delivery
+    order of a sealed frame sequence (UDP reordering), each frame is
+    accepted exactly once and every re-delivery is rejected."""
+
+    def test_any_order_each_frame_accepted_exactly_once(self):
+        from hypothesis import given, settings
+        from hypothesis import strategies as st
+
+        @settings(max_examples=100, deadline=None)
+        @given(st.permutations(list(range(16)) * 2))
+        def check(schedule):
+            # The drawn permutation IS the delivery order: each frame
+            # appears twice (a delivery and a duplicate), interleaved
+            # however Hypothesis explores.
+            tx = FrameAuth("k", sender="tx")
+            rx = FrameAuth("k", sender="rx")
+            frames = [tx.seal(f"m{i}".encode()) for i in range(16)]
+            accepted = []
+            seen = set()
+            for i in schedule:
+                try:
+                    payload = rx.open(frames[i])
+                    assert payload == f"m{i}".encode()
+                    assert i not in seen, f"frame {i} accepted twice"
+                    seen.add(i)
+                    accepted.append(i)
+                except AuthError:
+                    assert i in seen, f"frame {i} rejected before first delivery"
+            assert seen == set(range(16)), "some frame was never accepted"
+
+        check()
