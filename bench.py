@@ -132,6 +132,36 @@ def annotate_flash_entries(flash: dict, old_flash: dict) -> dict:
     return out
 
 
+def annotate_e2e(e2e: dict | None, old_e2e: dict | None) -> dict | None:
+    """Degradation guard for the e2e section, mirroring configs/curve/flash:
+    each rate field tracks its best-known (MAXIMUM), and a reading >2x
+    below best flags the section so merge_detail keeps the previous healthy
+    one — round 4: a degraded window wrote e2e 46 img/s / overlap 0.8x over
+    a healthy 113 / 1.37 with no guard on this section."""
+    if not e2e:
+        return e2e
+    e2e = dict(e2e)
+    old_e2e = old_e2e or {}
+    if old_e2e.get("model") != e2e.get("model"):
+        # A promoted-headline model's rates cannot be judged (or have its
+        # best-known seeded) by another model's history: a legitimately
+        # slower model would be flagged forever and never recorded.
+        old_e2e = {}
+    degraded = False
+    for leg in ("e2e_img_s", "serial_img_s", "decode_only_img_s", "decode_raw_img_s"):
+        cur = e2e.get(leg)
+        candidates = [x for x in (cur, old_e2e.get(f"best_{leg}"), old_e2e.get(leg)) if x]
+        if not candidates:
+            continue
+        best = max(candidates)
+        e2e[f"best_{leg}"] = round(best, 1)
+        if cur is not None and cur < best / 2.0:
+            degraded = True
+    if degraded:
+        e2e["degraded_vs_history"] = True
+    return e2e
+
+
 def update_history_best(history_best: dict, results: list[dict]) -> dict:
     """Fold this run's configs into the per-(model,batch) best-known record.
     Degraded-window measurements never improve the record, so a later healthy
@@ -236,6 +266,13 @@ def merge_detail(new: dict, old: dict) -> dict:
     # within the SAME model: a promoted-headline run's gaps must not be
     # filled with another model's rates.
     new_e2e, old_e2e = new.get("e2e"), old.get("e2e")
+    if (
+        new_e2e
+        and old_e2e
+        and new_e2e.get("degraded_vs_history")
+        and not old_e2e.get("degraded_vs_history")
+    ):
+        new_e2e = None  # keep the healthy committed section (stamped stale)
     if new_e2e and old_e2e and new_e2e.get("model") != old_e2e.get("model"):
         if any(v is None for v in new_e2e.values()):
             new_e2e = None  # partial for a different model: keep old whole
@@ -357,17 +394,31 @@ def bench_model(
     bufs = [make_buf(k) for k in jax.random.split(jax.random.PRNGKey(0), n_bufs)]
     jax.block_until_ready(bufs)
 
-    # Calibrate iteration count to ~`seconds` of steady state, min 10 batches.
-    # This sync round trip doubles as the first latency sample, so even a
-    # deadline-truncated run reports a real p50.
+    # Calibrate: one sync round trip (seeds the latency stats below)...
     t0 = time.perf_counter()
     jax.block_until_ready(engine._forward(engine.variables, bufs[0]))
     per_batch = time.perf_counter() - t0
-    iters = max(10, min(200, int(seconds / max(per_batch, 1e-4))))
+    # ...then a short ASYNC burst for the chip-time estimate that sizes the
+    # measurement. The sync round trip is dominated by tunnel RTT at small
+    # batches (resnet18@256: ~111 ms sync vs ~9 ms chip), so sizing iters
+    # from it ran 10x too few batches to reach steady state — the round-4
+    # small-batch curve noise. The burst amortizes the RTT across 8
+    # dispatches. Deadline-guarded: in a degraded window (or with the clock
+    # nearly spent) the burst is skipped and the sync estimate stands —
+    # 8 unguarded batches at 20x weather must not re-open the round-3
+    # budget blowout.
+    per_dispatch_s = max(per_batch, 1e-4)
+    if time_left() > per_batch * 12:
+        burst = 8
+        t0 = time.perf_counter()
+        outs = [engine._forward(engine.variables, bufs[i % n_bufs]) for i in range(burst)]
+        jax.block_until_ready(outs)
+        per_dispatch_s = max((time.perf_counter() - t0) / burst, 1e-4)
+    iters = max(10, min(200, int(seconds / per_dispatch_s)))
     if deadline is not None:
         # Fit at least `passes` throughput passes plus a short latency loop
         # into the remaining wall clock; min 3 keeps the measurement real.
-        cap = int(time_left() * 0.7 / max(passes, 1) / max(per_batch, 1e-4))
+        cap = int(time_left() * 0.7 / max(passes, 1) / per_dispatch_s)
         iters = max(3, min(iters, cap))
 
     # Throughput: async dispatch of every batch, one sync at the end — the
@@ -385,7 +436,7 @@ def bench_model(
         only the in-flight chunks — bounded seconds, not one unbounded
         block_until_ready on the whole pass (round-3 weather). Returns the
         elapsed time normalized to `iters` batches."""
-        chunk = max(1, min(iters, int(0.5 / max(per_batch, 1e-4))))
+        chunk = max(1, min(iters, int(0.5 / per_dispatch_s)))
         depth = 3
         t_start = time.perf_counter()
         in_flight: list[list] = []
@@ -987,11 +1038,14 @@ def main() -> None:
     e2e = None
     if args.e2e and not over_budget("e2e"):
         try:
-            e2e = bench_e2e(
-                head["model"],
-                base_batch,
-                args.corpus,
-                deadline=time.monotonic() + CAPS["e2e"],
+            e2e = annotate_e2e(
+                bench_e2e(
+                    head["model"],
+                    base_batch,
+                    args.corpus,
+                    deadline=time.monotonic() + CAPS["e2e"],
+                ),
+                prev_detail.get("e2e"),
             )
             print(
                 f"[bench-e2e] {e2e['model']} images={e2e['images']} "

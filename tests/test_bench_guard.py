@@ -321,3 +321,43 @@ class TestFlashEntryGuard:
         out = bench.merge_detail(new, old)
         assert out["flash"]["s2048_h8"]["flash_ms"] == 3.19
         assert out["flash"]["s2048_h8"]["stale"] is True
+
+
+class TestE2eGuard:
+    OLD = {"model": "resnet18", "e2e_img_s": 113.2, "serial_img_s": 82.0,
+           "decode_only_img_s": 684.0, "decode_raw_img_s": 1836.0,
+           "overlap_speedup": 1.37}
+
+    def test_healthy_advances_best(self):
+        out = bench.annotate_e2e({"model": "resnet18", "e2e_img_s": 120.0,
+                                  "serial_img_s": 85.0}, self.OLD)
+        assert out["best_e2e_img_s"] == 120.0
+        assert "degraded_vs_history" not in out
+
+    def test_collapsed_window_flagged_and_merge_keeps_healthy(self):
+        # The literal round-4 capture: e2e 46.3 / overlap 0.8 over 113 / 1.37.
+        new = bench.annotate_e2e({"model": "resnet18", "e2e_img_s": 46.3,
+                                  "serial_img_s": 58.0}, self.OLD)
+        assert new["degraded_vs_history"] is True
+        assert new["best_e2e_img_s"] == 113.2  # the record never degrades
+        merged = bench.merge_detail({"configs": [], "e2e": new},
+                                    {"configs": [], "e2e": self.OLD})
+        assert merged["e2e"]["e2e_img_s"] == 113.2
+        assert merged["e2e"]["stale"] is True
+
+    def test_no_history_never_flags(self):
+        out = bench.annotate_e2e({"model": "resnet18", "e2e_img_s": 46.3}, None)
+        assert "degraded_vs_history" not in out
+        assert out["best_e2e_img_s"] == 46.3
+
+    def test_none_passthrough(self):
+        assert bench.annotate_e2e(None, self.OLD) is None
+
+    def test_model_change_judged_fresh(self):
+        # A promoted-headline model (legitimately slower) must not be
+        # flagged against the previous model's rates, nor inherit its
+        # best-known records.
+        out = bench.annotate_e2e({"model": "clip_vit_l14", "e2e_img_s": 50.0},
+                                 self.OLD)
+        assert "degraded_vs_history" not in out
+        assert out["best_e2e_img_s"] == 50.0
