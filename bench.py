@@ -104,32 +104,52 @@ def degraded_vs_best(r: dict, history_best: dict, factor: float = 3.0) -> bool:
     return slow_lat or slow_thr
 
 
-def annotate_flash_entries(flash: dict, old_flash: dict) -> dict:
-    """Per-entry degradation guard for the flash microbench, mirroring the
-    configs'/curve's history protection: each timed entry tracks its
-    best-known (MINIMUM) flash/dense ms, and a reading >2x its best is
-    flagged so merge_detail keeps the previous healthy entry — one noisy
-    20-iter window must not commit a 'flash 1.45x slower than dense'
-    artifact the kernel docstring cites as parity evidence (review r4)."""
+def _annotate_rate_entries(
+    section: dict, old_section: dict, legs: tuple, better, ndigits: int,
+    config_keys: tuple = (),
+) -> dict:
+    """Shared per-entry degradation annotator for dict-of-entry sections
+    (flash, train). Each entry's ``legs`` track their best-known value
+    (``better`` = min for timings, max for rates); a reading >2x worse than
+    best flags the entry so merge_detail keeps the previous healthy one.
+    History resets when any ``config_keys`` field changed — a deliberate
+    batch/seq/chip-count change must be judged fresh, not flagged forever
+    (same rule as annotate_e2e's model reset)."""
+    worse2x = (lambda cur, best: cur > 2.0 * best) if better is min else (
+        lambda cur, best: cur < best / 2.0
+    )
     out = {}
-    for key, r in flash.items():
+    for key, r in (section or {}).items():
+        if not isinstance(r, dict):
+            out[key] = r
+            continue
         r = dict(r)
-        prev = old_flash.get(key) or {}
+        prev = (old_section or {}).get(key) or {}
+        if any(prev.get(k) != r.get(k) for k in config_keys):
+            prev = {}
         degraded = False
-        for leg in ("flash_ms", "dense_ms"):
+        for leg in legs:
             cur = r.get(leg)
-            if cur is None:
+            candidates = [x for x in (cur, prev.get(f"best_{leg}"), prev.get(leg)) if x]
+            if not candidates:
                 continue
-            best = min(
-                x for x in (cur, prev.get(f"best_{leg}"), prev.get(leg)) if x
-            )
-            r[f"best_{leg}"] = round(best, 2)
-            if cur > 2.0 * best:
+            best = better(candidates)
+            r[f"best_{leg}"] = round(best, ndigits)
+            if cur is not None and worse2x(cur, best):
                 degraded = True
         if degraded:
             r["degraded_vs_history"] = True
         out[key] = r
     return out
+
+
+def annotate_flash_entries(flash: dict, old_flash: dict) -> dict:
+    """Flash microbench guard: best-known (MINIMUM) timings per entry — one
+    noisy 20-iter window must not commit a 'flash 1.45x slower than dense'
+    artifact the kernel docstring cites as parity evidence (review r4)."""
+    return _annotate_rate_entries(
+        flash, old_flash, ("flash_ms", "dense_ms"), min, 2
+    )
 
 
 def annotate_e2e(e2e: dict | None, old_e2e: dict | None) -> dict | None:
@@ -160,6 +180,18 @@ def annotate_e2e(e2e: dict | None, old_e2e: dict | None) -> dict | None:
     if degraded:
         e2e["degraded_vs_history"] = True
     return e2e
+
+
+def annotate_train_entries(train: dict, old_train: dict) -> dict:
+    """Train-section guard — the last unguarded one (round 4: a degraded
+    window wrote lm_flash_train 2.8k tok/s over the healthy 88k). PER-CHIP
+    rates, like every other guard in this file, so a chip-count change
+    cannot wedge the section; batch/seq/chips changes reset history."""
+    return _annotate_rate_entries(
+        train, old_train,
+        ("images_per_sec_per_chip", "tokens_per_sec_per_chip"), max, 1,
+        config_keys=("batch", "seq", "chips"),
+    )
 
 
 def update_history_best(history_best: dict, results: list[dict]) -> dict:
@@ -1153,7 +1185,10 @@ def main() -> None:
     train = {}
     if not over_budget("train"):
         try:
-            train = bench_train(deadline=time.monotonic() + CAPS["train"])
+            train = annotate_train_entries(
+                bench_train(deadline=time.monotonic() + CAPS["train"]),
+                prev_detail.get("train") or {},
+            )
             for key, r in train.items():
                 rate = r.get("images_per_sec") or r.get("tokens_per_sec")
                 unit = "img/s" if "images_per_sec" in r else "tok/s"

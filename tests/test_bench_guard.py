@@ -361,3 +361,42 @@ class TestE2eGuard:
                                  self.OLD)
         assert "degraded_vs_history" not in out
         assert out["best_e2e_img_s"] == 50.0
+
+
+class TestTrainGuard:
+    OLD = {"lm_flash_train": {"batch": 8, "seq": 2048, "chips": 1,
+                              "tokens_per_sec_per_chip": 88216.0, "step_ms": 185.7},
+           "vit_b16_train": {"batch": 128, "chips": 1,
+                             "images_per_sec_per_chip": 827.2, "step_ms": 154.7}}
+
+    def test_collapsed_entry_flagged_and_merge_keeps_healthy(self):
+        # The literal round-4 capture: 2845 tok/s over the healthy 88k.
+        new = bench.annotate_train_entries(
+            {"lm_flash_train": {"batch": 8, "seq": 2048, "chips": 1,
+                                "tokens_per_sec_per_chip": 2845.0, "step_ms": 5759.2},
+             "vit_b16_train": {"batch": 128, "chips": 1,
+                               "images_per_sec_per_chip": 820.3, "step_ms": 156.0}},
+            self.OLD)
+        assert new["lm_flash_train"]["degraded_vs_history"] is True
+        assert new["lm_flash_train"]["best_tokens_per_sec_per_chip"] == 88216.0
+        assert "degraded_vs_history" not in new["vit_b16_train"]
+        merged = bench.merge_detail({"configs": [], "train": new},
+                                    {"configs": [], "train": self.OLD})
+        assert merged["train"]["lm_flash_train"]["tokens_per_sec_per_chip"] == 88216.0
+        assert merged["train"]["lm_flash_train"]["stale"] is True
+        assert merged["train"]["vit_b16_train"]["images_per_sec_per_chip"] == 820.3
+
+    def test_config_change_judged_fresh(self):
+        # A deliberate batch/seq/chips change resets history: a legitimate
+        # slower config must not be flagged forever.
+        new = bench.annotate_train_entries(
+            {"lm_flash_train": {"batch": 2, "seq": 2048, "chips": 1,
+                                "tokens_per_sec_per_chip": 30000.0}},
+            self.OLD)
+        assert "degraded_vs_history" not in new["lm_flash_train"]
+        assert new["lm_flash_train"]["best_tokens_per_sec_per_chip"] == 30000.0
+
+    def test_no_history_never_flags(self):
+        out = bench.annotate_train_entries(
+            {"lm_flash_train": {"tokens_per_sec_per_chip": 2845.0}}, {})
+        assert "degraded_vs_history" not in out["lm_flash_train"]
