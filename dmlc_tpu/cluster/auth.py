@@ -11,18 +11,23 @@ grants ``sdfs.delete`` / ``job.start``.
 
 Replay protection: every sealed frame carries a per-sender monotonic
 sequence number (nanosecond clock, forced strictly increasing per process)
-inside the MAC'd region. A receiver tracks, per sender, the highest sequence
-seen plus a sliding window of recently accepted values:
+AND the intended recipient address inside the MAC'd region. A receiver
+tracks, per sender, the highest sequence seen plus a sliding window of
+recently accepted values:
 
+- a frame whose recipient is not one of the receiver's registered
+  identities is rejected — a frame recorded in flight to member A cannot
+  be replayed (even once, even fresh) against members B..Z, whose replay
+  windows for the sender are independent of A's,
 - a frame at or below ``highest - window`` is rejected (too old),
 - a frame inside the window that was already accepted is rejected (replay),
 - out-of-order but fresh UDP datagrams inside the window still pass,
 - the FIRST frame from a sender this receiver has no state for must be
   within ``max_age_s`` of the receiver's clock — so a recorded frame cannot
   be replayed against a freshly restarted receiver long after capture.
-  (Within ``max_age_s`` of capture, a restart-then-replay races the real
-  sender's next frame; the bound is freshness, not perfect one-shot
-  semantics. The reference had no authentication at all.)
+  (Within ``max_age_s`` of capture, a restart-then-replay against the SAME
+  recipient races the real sender's next frame; the bound is freshness, not
+  perfect one-shot semantics. The reference had no authentication at all.)
 
 Design notes:
 - The tag is truncated to 16 bytes (standard HMAC truncation; 128-bit
@@ -33,6 +38,17 @@ Design notes:
 - The freshness bound assumes fleet clocks within ``max_age_s`` (default
   120 s) of each other — ordinary NTP territory, and only consulted for
   senders with no receiver-side state yet.
+- Clock-regression constraint for KNOWN senders: sequence numbers are
+  wall-clock nanoseconds, so a process that restarts under the same sender
+  id ("host:port") with a clock more than ``window_s`` (default 60 s)
+  BEHIND its previous run re-enters below the high-water mark peers retain
+  for it, and its frames are rejected ("below replay window") until its
+  clock passes the old mark. This is tighter than the ``max_age_s`` skew
+  bound above and is deliberate: auto-resetting a peer window on a
+  below-floor-but-fresh sequence would let an attacker replay any recorded
+  frame in the (window_s, max_age_s] age range once per reset. Operators
+  restarting a node behind a badly-regressed clock can wait out the
+  window or restart it under a fresh port.
 """
 
 from __future__ import annotations
@@ -46,19 +62,29 @@ import time
 
 
 TAG_BYTES = 16
-_HDR = struct.Struct("!QB")  # sequence (ns clock), sender-id length
+# version, sequence (ns clock), sender len, recipient len — the version
+# byte (MAC'd with the rest) makes envelope-format changes explicit: a
+# mixed-version fleet fails with "unsupported frame version", not with
+# shifted-field parses that masquerade as recipient mismatches.
+_HDR = struct.Struct("!BQBB")
+_VERSION = 2  # v1 was the unversioned !QB sender-only envelope (round 4)
 _MAX_SENDERS = 1024  # replay-state LRU bound: gossip fan-in is << this
 
 
 class AuthError(Exception):
-    """Frame failed authentication (missing, truncated, wrong tag, replay)."""
+    """Frame failed authentication (missing, truncated, wrong tag, wrong
+    recipient, replay)."""
 
 
 class FrameAuth:
     """Seals/opens byte frames: truncated HMAC-SHA256 tag over a
-    (sequence, sender, payload) envelope, with receiver-side replay windows.
+    (sequence, sender, recipient, payload) envelope, with receiver-side
+    replay windows and destination binding.
 
-    One instance per process endpoint; safe for concurrent use (server
+    One instance per process (a node's gossip endpoint, RPC client, and RPC
+    servers share it); each listening endpoint registers its advertised
+    address via :meth:`add_identity` so ``open`` can verify the sealed
+    recipient names THIS process. Safe for concurrent use (server
     connection threads share the receiver state under a lock).
     """
 
@@ -80,31 +106,68 @@ class FrameAuth:
         self._max_age_ns = int(max_age_s * 1e9)
         self._lock = threading.Lock()
         self._last_seq = 0
+        # Addresses this process answers for: its own sender id (replies
+        # come back addressed to it) plus every server/transport address
+        # registered via add_identity.
+        self._identities: set[bytes] = {sid}
         # sender id -> (highest seq seen, set of accepted seqs in window)
         self._peers: dict[bytes, tuple[int, set[int]]] = {}
+
+    def add_identity(self, address: str | bytes) -> None:
+        """Register an address this process listens on (server bind address,
+        gossip endpoint) as a valid sealed-frame recipient."""
+        aid = address.encode() if isinstance(address, str) else bytes(address)
+        if not aid or len(aid) > 255:
+            raise ValueError("identity must be 1..255 bytes")
+        with self._lock:
+            self._identities.add(aid)
 
     def _tag(self, data: bytes) -> bytes:
         return hmac.new(self._key, data, hashlib.sha256).digest()[:TAG_BYTES]
 
-    def seal(self, data: bytes) -> bytes:
+    def seal(self, data: bytes, recipient: str | bytes) -> bytes:
+        """Seal ``data`` for one destination address; ``open`` at any
+        process not answering for that address rejects the frame."""
+        rid = recipient.encode() if isinstance(recipient, str) else bytes(recipient)
+        if not rid or len(rid) > 255:
+            raise ValueError("recipient must be 1..255 bytes")
         with self._lock:
             seq = max(self._last_seq + 1, time.time_ns())
             self._last_seq = seq
-        body = _HDR.pack(seq, len(self._sender)) + self._sender + data
+        body = (
+            _HDR.pack(_VERSION, seq, len(self._sender), len(rid))
+            + self._sender + rid + data
+        )
         return self._tag(body) + body
 
-    def open(self, frame: bytes) -> bytes:
+    def open(self, frame: bytes) -> tuple[bytes, bytes]:
+        """Verify and unwrap a sealed frame.
+
+        Returns ``(payload, sender_id)`` — servers address their reply to
+        the authenticated sender id. Raises :class:`AuthError` on any
+        failure, including a recipient that is not one of this process's
+        registered identities.
+        """
         if len(frame) < TAG_BYTES + _HDR.size:
             raise AuthError(f"frame of {len(frame)} bytes is shorter than the envelope")
         tag, body = frame[:TAG_BYTES], frame[TAG_BYTES:]
         if not hmac.compare_digest(tag, self._tag(body)):
             raise AuthError("bad frame tag")
-        seq, sender_len = _HDR.unpack_from(body)
-        sender = body[_HDR.size : _HDR.size + sender_len]
-        if len(sender) != sender_len:
-            raise AuthError("truncated sender id")
+        version, seq, sender_len, recipient_len = _HDR.unpack_from(body)
+        if version != _VERSION:
+            raise AuthError(f"unsupported frame version {version}")
+        sender_end = _HDR.size + sender_len
+        recipient_end = sender_end + recipient_len
+        sender = body[_HDR.size:sender_end]
+        recipient = body[sender_end:recipient_end]
+        if len(sender) != sender_len or len(recipient) != recipient_len:
+            raise AuthError("truncated sender/recipient id")
+        with self._lock:
+            addressed_here = recipient in self._identities
+        if not addressed_here:
+            raise AuthError("frame sealed for a different recipient")
         self._check_replay(sender, seq)
-        return body[_HDR.size + sender_len :]
+        return body[recipient_end:], sender
 
     def _check_replay(self, sender: bytes, seq: int) -> None:
         with self._lock:

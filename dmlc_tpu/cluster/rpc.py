@@ -125,24 +125,36 @@ _HDR = struct.Struct("!I")  # 4-byte big-endian frame length
 MAX_FRAME = 1 << 30  # 1 GiB — model weights fit; corrupt headers don't OOM us
 
 
-def _send_frame(sock: socket.socket, obj: dict, auth: FrameAuth | None = None) -> None:
+def _send_frame(
+    sock: socket.socket,
+    obj: dict,
+    auth: FrameAuth | None = None,
+    recipient: str | bytes | None = None,
+) -> None:
     data = msgpack.packb(obj, use_bin_type=True)
     if auth is not None:
-        data = auth.seal(data)
+        if not recipient:
+            raise RpcError("sealed frames require an explicit recipient")
+        data = auth.seal(data, recipient=recipient)
     if len(data) > MAX_FRAME:
         raise RpcError(f"frame of {len(data)} bytes exceeds MAX_FRAME")
     sock.sendall(_HDR.pack(len(data)) + data)
 
 
-def _recv_frame(sock: socket.socket, auth: FrameAuth | None = None) -> dict:
+def _recv_frame(
+    sock: socket.socket, auth: FrameAuth | None = None
+) -> tuple[dict, bytes | None]:
+    """Returns ``(message, authenticated_sender_id)`` — the sender id is the
+    reply's sealed destination; ``None`` when authentication is off."""
     hdr = _recv_exact(sock, _HDR.size)
     (length,) = _HDR.unpack(hdr)
     if length > MAX_FRAME:
         raise RpcUnreachable(f"frame header claims {length} bytes (> MAX_FRAME)")
     data = bytes(_recv_exact(sock, length))
+    sender = None
     if auth is not None:
-        data = auth.open(data)  # AuthError -> caller drops the connection
-    return msgpack.unpackb(data, raw=False)
+        data, sender = auth.open(data)  # AuthError -> caller drops the connection
+    return msgpack.unpackb(data, raw=False), sender
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytearray:
@@ -174,6 +186,10 @@ class TcpRpcServer:
         self.sock.bind((host, port))
         self.sock.listen(64)
         self.address = f"{host}:{self.sock.getsockname()[1]}"
+        if auth is not None:
+            # Clients seal requests for this server's address; frames
+            # recorded in flight to any other endpoint are rejected here.
+            auth.add_identity(self.address)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._thread.start()
@@ -193,20 +209,29 @@ class TcpRpcServer:
         with conn:
             try:
                 while True:
-                    req = _recv_frame(conn, self.auth)
+                    req, peer = _recv_frame(conn, self.auth)
+                    # Replies are sealed for the AUTHENTICATED requester id,
+                    # so a recorded reply cannot be replayed to anyone else.
                     try:
                         reply = _dispatch(self.methods, req["m"], req["p"])
-                        _send_frame(conn, {"ok": True, "r": reply}, self.auth)
+                        _send_frame(conn, {"ok": True, "r": reply}, self.auth, recipient=peer)
                     except Exception as e:  # method error -> remote RpcError
                         _send_frame(
-                            conn, {"ok": False, "e": f"{type(e).__name__}: {e}"}, self.auth
+                            conn,
+                            {"ok": False, "e": f"{type(e).__name__}: {e}"},
+                            self.auth,
+                            recipient=peer,
                         )
             except (RpcUnreachable, OSError):
                 return  # client went away
-            except AuthError:
+            except AuthError as e:
                 # Unauthenticated frame: drop the connection WITHOUT an error
-                # reply — an unkeyed caller gets silence, not an oracle.
-                log.warning("closing connection after unauthenticated frame")
+                # reply — an unkeyed caller gets silence, not an oracle. The
+                # reason is logged server-side for the operator: a
+                # wrong-recipient drop usually means the caller dialed an
+                # alias (DNS name, 127.0.0.1) instead of the canonical
+                # config.host address the frame must be sealed for.
+                log.warning("closing connection after unauthenticated frame: %s", e)
                 return
             except Exception:
                 # Malformed frame (bad msgpack, missing keys): drop the
@@ -223,7 +248,14 @@ class TcpRpcServer:
 class TcpRpc(Rpc):
     """One connection per call. Control messages are small and infrequent
     (heartbeats ride UDP, tensor bytes ride ICI/PCIe), so connection reuse
-    is not worth the failure-mode complexity here."""
+    is not worth the failure-mode complexity here.
+
+    With auth enabled, requests are sealed for the DIALED address, and the
+    server only opens frames sealed for an address it registered — so keyed
+    callers must dial members by their canonical ``config.host:port``
+    strings (the ones membership gossips), not an alias ('localhost', a DNS
+    name, a second NIC). Every in-tree caller gets addresses from
+    membership/config, which satisfies this by construction."""
 
     def __init__(self, auth: FrameAuth | None = None):
         self.auth = auth
@@ -233,10 +265,10 @@ class TcpRpc(Rpc):
         try:
             with socket.create_connection((host, int(port)), timeout=timeout) as sock:
                 sock.settimeout(timeout)
-                _send_frame(sock, {"m": method, "p": payload}, self.auth)
+                _send_frame(sock, {"m": method, "p": payload}, self.auth, recipient=addr)
                 # Replies are authenticated too: a spoofed leader cannot feed
                 # a keyed member forged directory state.
-                reply = _recv_frame(sock, self.auth)
+                reply, _ = _recv_frame(sock, self.auth)
         except RpcUnreachable:
             raise
         except AuthError as e:

@@ -21,16 +21,16 @@ from dmlc_tpu.cluster.transport import UdpTransport
 class TestFrameAuthReplay:
     def test_roundtrip_and_replay_rejected(self):
         a, b = FrameAuth("k", sender="a"), FrameAuth("k", sender="b")
-        frame = a.seal(b"payload")
-        assert b.open(frame) == b"payload"
+        frame = a.seal(b"payload", recipient="b")
+        assert b.open(frame) == (b"payload", b"a")
         with pytest.raises(AuthError, match="replay"):
             b.open(frame)
 
     def test_sequences_strictly_increase_per_sender(self):
         a, b = FrameAuth("k", sender="a"), FrameAuth("k", sender="b")
-        frames = [a.seal(f"m{i}".encode()) for i in range(50)]
+        frames = [a.seal(f"m{i}".encode(), recipient="b") for i in range(50)]
         for i, f in enumerate(frames):
-            assert b.open(f) == f"m{i}".encode()
+            assert b.open(f)[0] == f"m{i}".encode()
         # Every already-delivered frame is a replay, wherever it sits.
         for f in (frames[0], frames[25], frames[-1]):
             with pytest.raises(AuthError, match="replay"):
@@ -39,18 +39,18 @@ class TestFrameAuthReplay:
     def test_out_of_order_within_window_accepted(self):
         # UDP reordering: an older-but-fresh datagram still lands once.
         a, b = FrameAuth("k", sender="a"), FrameAuth("k", sender="b")
-        f1, f2 = a.seal(b"one"), a.seal(b"two")
-        assert b.open(f2) == b"two"
-        assert b.open(f1) == b"one"
+        f1, f2 = a.seal(b"one", recipient="b"), a.seal(b"two", recipient="b")
+        assert b.open(f2)[0] == b"two"
+        assert b.open(f1)[0] == b"one"
         with pytest.raises(AuthError, match="replay"):
             b.open(f1)
 
     def test_below_window_rejected(self):
         a = FrameAuth("k", sender="a")
         b = FrameAuth("k", sender="b", window_s=0.05)
-        old = a.seal(b"old")
+        old = a.seal(b"old", recipient="b")
         time.sleep(0.1)
-        assert b.open(a.seal(b"fresh")) == b"fresh"
+        assert b.open(a.seal(b"fresh", recipient="b"))[0] == b"fresh"
         with pytest.raises(AuthError, match="below replay window"):
             b.open(old)
 
@@ -58,7 +58,7 @@ class TestFrameAuthReplay:
         # A recorded frame replayed against a RESTARTED receiver (no state
         # for the sender) is rejected once it is older than max_age_s.
         a = FrameAuth("k", sender="a")
-        old = a.seal(b"recorded")
+        old = a.seal(b"recorded", recipient="b")
         restarted = FrameAuth("k", sender="b", max_age_s=0.05)
         time.sleep(0.1)
         with pytest.raises(AuthError, match="stale frame from unknown sender"):
@@ -66,19 +66,33 @@ class TestFrameAuthReplay:
 
     def test_tampered_and_truncated_frames_rejected(self):
         a, b = FrameAuth("k", sender="a"), FrameAuth("k", sender="b")
-        frame = bytearray(a.seal(b"payload"))
+        frame = bytearray(a.seal(b"payload", recipient="b"))
         frame[-1] ^= 0xFF
         with pytest.raises(AuthError, match="bad frame tag"):
             b.open(bytes(frame))
         with pytest.raises(AuthError, match="shorter than the envelope"):
             b.open(b"short")
 
+    def test_cross_recipient_replay_rejected(self):
+        # ADVICE r4 medium: a frame recorded in flight to member B must not
+        # open at member C — even fresh, even on its first delivery.
+        a = FrameAuth("k", sender="a")
+        b = FrameAuth("k", sender="b")
+        c = FrameAuth("k", sender="c")
+        frame = a.seal(b"sdfs.delete", recipient="b")
+        with pytest.raises(AuthError, match="different recipient"):
+            c.open(frame)
+        assert b.open(frame)[0] == b"sdfs.delete"  # intended target still works
+        # Registered server identities are honored alongside the sender id.
+        c.add_identity("10.0.0.3:9001")
+        assert c.open(a.seal(b"req", recipient="10.0.0.3:9001"))[0] == b"req"
+
     def test_sender_state_bounded(self):
         from dmlc_tpu.cluster import auth as auth_mod
 
         b = FrameAuth("k", sender="rx")
         for i in range(auth_mod._MAX_SENDERS + 10):
-            b.open(FrameAuth("k", sender=f"s{i}").seal(b"x"))
+            b.open(FrameAuth("k", sender=f"s{i}").seal(b"x", recipient="rx"))
         assert len(b._peers) <= auth_mod._MAX_SENDERS
 
 
@@ -108,7 +122,8 @@ class TestTcpReplay:
             client_auth = FrameAuth("fleet", sender="cli")
             # The legitimate call, captured on the wire by the attacker.
             recorded = client_auth.seal(
-                msgpack.packb({"m": "sdfs.delete", "p": {"name": "f1"}}, use_bin_type=True)
+                msgpack.packb({"m": "sdfs.delete", "p": {"name": "f1"}}, use_bin_type=True),
+                recipient=server.address,
             )
             reply = _raw_send_tcp(server.address, recorded)
             assert deleted == ["f1"] and reply  # legit call executed
@@ -122,6 +137,31 @@ class TestTcpReplay:
             assert deleted == ["f1", "f2"]
         finally:
             server.close()
+
+    def test_recorded_frame_dropped_at_other_member(self):
+        """ADVICE r4 medium, end to end: a request recorded in flight to
+        member A replayed at member B (same fleet key, independent replay
+        window for the sender) must not execute at B."""
+        calls = {"a": [], "b": []}
+        server_a = TcpRpcServer(
+            "127.0.0.1", 0, {"sdfs.delete": lambda p: (calls["a"].append(p["name"]), {})[1]},
+            auth=FrameAuth("fleet", sender="member-a"),
+        )
+        server_b = TcpRpcServer(
+            "127.0.0.1", 0, {"sdfs.delete": lambda p: (calls["b"].append(p["name"]), {})[1]},
+            auth=FrameAuth("fleet", sender="member-b"),
+        )
+        try:
+            recorded = FrameAuth("fleet", sender="cli").seal(
+                msgpack.packb({"m": "sdfs.delete", "p": {"name": "f1"}}, use_bin_type=True),
+                recipient=server_a.address,
+            )
+            assert _raw_send_tcp(server_a.address, recorded)  # legit target runs it
+            assert _raw_send_tcp(server_b.address, recorded) == b""
+            assert calls == {"a": ["f1"], "b": []}, "frame executed at the wrong member"
+        finally:
+            server_a.close()
+            server_b.close()
 
     def test_normal_repeated_calls_unaffected(self):
         server = TcpRpcServer(
@@ -145,7 +185,8 @@ def test_udp_replayed_datagram_dropped():
     try:
         sender_auth = FrameAuth("fleet", sender="tx")
         datagram = sender_auth.seal(
-            msgpack.packb({"t": "failed-claim"}, use_bin_type=True)
+            msgpack.packb({"t": "failed-claim"}, use_bin_type=True),
+            recipient=rx.address,
         )
         host, _, port = rx.address.rpartition(":")
         raw = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
@@ -181,12 +222,12 @@ class TestReplayWindowProperties:
             # however Hypothesis explores.
             tx = FrameAuth("k", sender="tx")
             rx = FrameAuth("k", sender="rx")
-            frames = [tx.seal(f"m{i}".encode()) for i in range(16)]
+            frames = [tx.seal(f"m{i}".encode(), recipient="rx") for i in range(16)]
             accepted = []
             seen = set()
             for i in schedule:
                 try:
-                    payload = rx.open(frames[i])
+                    payload, _ = rx.open(frames[i])
                     assert payload == f"m{i}".encode()
                     assert i not in seen, f"frame {i} accepted twice"
                     seen.add(i)
