@@ -123,6 +123,23 @@ def export_bundle(
                     f"weight leaf {n_weight_args} shape {leaf.shape} != "
                     f"exported aval {aval.shape} — flatten order drifted"
                 )
+            if str(leaf.dtype) != str(aval.dtype):
+                # Same-itemsize mismatches (i32 vs f32) would otherwise write
+                # silently-wrong raw bytes the host stages verbatim. Pure
+                # precision differences (an f32-trained checkpoint feeding a
+                # bf16 program) are cast; anything kind-crossing is a real
+                # flatten drift and fails here, not at host load.
+                import jax.numpy as jnp
+
+                if jnp.issubdtype(leaf.dtype, np.floating) and jnp.issubdtype(
+                    aval.dtype, np.floating
+                ):
+                    leaf = np.asarray(leaf, dtype=aval.dtype)
+                else:
+                    raise ValueError(
+                        f"weight leaf {n_weight_args} dtype {leaf.dtype} != "
+                        f"exported aval dtype {aval.dtype} — flatten order drifted"
+                    )
             fname = f"arg{n_weight_args}.raw"
             (out_dir / fname).write_bytes(leaf.tobytes())
             lines.append(f"{dt}:{shape}={fname}")

@@ -236,8 +236,13 @@ class JobScheduler:
         # One gang shard in flight at a time: two concurrent collectives
         # over one mesh would interleave their participants and deadlock.
         self._gang_lock = threading.Lock()
-        self._gang_pool = None  # lazy persistent fan-out pool (not per shard)
+        # Two lazy persistent fan-out pools (not per shard): decode prefetch
+        # and collective execution must not share workers — see
+        # _ensure_gang_pool.
+        self._gang_pool = None
         self._gang_pool_size = 0
+        self._gang_exec_pool = None
+        self._gang_exec_pool_size = 0
         self._gang_pool_lock = threading.Lock()
         self.gang_max_consec_failures = 8
         self.jobs: dict[str, Job] = {
@@ -464,9 +469,15 @@ class JobScheduler:
     DECODE_PREFETCH_TIMEOUT_S = 30.0
 
     def _ensure_gang_pool(self, world: int):
-        """Shared fan-out pool, sized for decode prefetch AND collective
-        execution futures in flight at once (2x world), under its own lock
-        so pool management never contends with the gang serialization.
+        """Fan-out pools under their own lock so pool management never
+        contends with the gang serialization. Returns ``(decode_pool,
+        exec_pool)`` — SEPARATE executors, because mixing them lets phase-1
+        decode tasks (up to DECODE_PREFETCH_TIMEOUT_S each, several
+        dispatcher threads deep) queue ahead of the serialized collective's
+        futures and stretch the gang critical path. The exec pool only ever
+        carries one shard's collective (submits happen under _gang_lock), so
+        ``world`` workers never queue; the decode pool is 2x world for two
+        dispatchers prefetching at once.
         A replaced (grown) pool is NOT shut down: another dispatcher thread
         may hold the old reference between _ensure_gang_pool and submit,
         and submit-after-shutdown raises. The abandoned pool's idle workers
@@ -479,9 +490,15 @@ class JobScheduler:
             if self._gang_pool is None or self._gang_pool_size < need:
                 self._gang_pool_size = need
                 self._gang_pool = concurrent.futures.ThreadPoolExecutor(
-                    max_workers=need, thread_name_prefix="gang"
+                    max_workers=need, thread_name_prefix="gang-decode"
                 )
-            return self._gang_pool
+            need_exec = max(world, 4)
+            if self._gang_exec_pool is None or self._gang_exec_pool_size < need_exec:
+                self._gang_exec_pool_size = need_exec
+                self._gang_exec_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=need_exec, thread_name_prefix="gang-exec"
+                )
+            return self._gang_pool, self._gang_exec_pool
 
     def _run_gang_shard(self, job_name: str, group: dict, offset: int, shard) -> int:
         job = self.jobs[job_name]
@@ -512,7 +529,7 @@ class JobScheduler:
             except Exception:
                 return False  # best-effort: the member will decode inline
 
-        pool = self._ensure_gang_pool(world)
+        pool, exec_pool = self._ensure_gang_pool(world)
 
         # Phase 1 — prefetch decode on every member, OUTSIDE the gang lock:
         # while the previous gang shard's collective executes (holding
@@ -544,7 +561,7 @@ class JobScheduler:
         # deadlock.
         with self._gang_lock:
             futures = {
-                rank: pool.submit(call_one, addr, rank)
+                rank: exec_pool.submit(call_one, addr, rank)
                 for addr, rank in sorted(group.items(), key=lambda kv: kv[1])
             }
             by_rank: dict[int, list] = {}
