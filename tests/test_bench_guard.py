@@ -52,6 +52,68 @@ class TestDegradedVsBest:
         assert not bench.degraded_vs_best(_cfg(batch=512, ips=19000, p50=None), hb)
 
 
+class TestConfigTailGuard:
+    """VERDICT r4 weak #4: committed p99s must reflect chip behavior or
+    carry an explicit degraded annotation."""
+
+    HB_TAIL = {
+        "resnet50@512": {
+            "images_per_sec_per_chip": 12000.0,
+            "p50_ms": 145.0,
+            "p99_ms": 152.0,
+            "tail_ratio": 1.05,
+        }
+    }
+
+    def test_contaminated_tail_flagged_with_best_known(self):
+        # The literal shipping artifact: resnet50 p99 314 ms over p50 147.
+        r = _cfg(model="resnet50", batch=512, ips=11900.0, p50=147.0, p99_ms=314.0)
+        bench.annotate_config_tails([r], self.HB_TAIL)
+        assert r["tail_degraded_vs_history"]
+        assert r["tail_ratio"] == 2.14
+        assert r["best_p99_ms"] == 152.0
+
+    def test_healthy_tail_not_flagged(self):
+        r = _cfg(model="resnet50", batch=512, ips=12000.0, p50=145.0, p99_ms=155.0)
+        bench.annotate_config_tails([r], self.HB_TAIL)
+        assert "tail_degraded_vs_history" not in r
+        assert r["best_p99_ms"] == 152.0
+
+    def test_no_history_records_but_never_flags(self):
+        # A genuinely heavy-tailed model gets an honest record, not a flag.
+        r = _cfg(model="vit_b16", batch=256, ips=2200.0, p50=100.0, p99_ms=250.0)
+        bench.annotate_config_tails([r], self.HB_TAIL)
+        assert r["tail_ratio"] == 2.5
+        assert "tail_degraded_vs_history" not in r
+
+    def test_naturally_wide_tail_within_history_not_flagged(self):
+        hb = {"vit_b16@256": {"p99_ms": 180.0, "tail_ratio": 1.8}}
+        r = _cfg(model="vit_b16", batch=256, ips=2200.0, p50=100.0, p99_ms=190.0)
+        bench.annotate_config_tails([r], hb)
+        assert "tail_degraded_vs_history" not in r
+
+    def test_history_folds_min_tail_and_skips_contaminated(self):
+        healthy = _cfg(model="resnet50", batch=512, ips=11000.0, p50=146.0, p99_ms=150.0)
+        out = bench.update_history_best(self.HB_TAIL, [healthy])
+        assert out["resnet50@512"]["p99_ms"] == 150.0
+        assert out["resnet50@512"]["tail_ratio"] < 1.05
+        contaminated = _cfg(
+            model="resnet50", batch=512, ips=11900.0, p50=147.0, p99_ms=314.0,
+            tail_degraded_vs_history=True,
+        )
+        out = bench.update_history_best(self.HB_TAIL, [contaminated])
+        assert out["resnet50@512"]["p99_ms"] == 152.0
+        assert out["resnet50@512"]["tail_ratio"] == 1.05
+
+    def test_throughput_advance_keeps_tail_record(self):
+        # A new throughput best must not erase the p99/ratio reference.
+        r = _cfg(model="resnet50", batch=512, ips=12500.0, p50=144.0)
+        out = bench.update_history_best(self.HB_TAIL, [r])
+        assert out["resnet50@512"]["images_per_sec_per_chip"] == 12500.0
+        assert out["resnet50@512"]["p99_ms"] == 152.0
+        assert out["resnet50@512"]["tail_ratio"] == 1.05
+
+
 class TestHistoryBest:
     def test_degraded_never_improves_record(self):
         out = bench.update_history_best(HB, [_cfg(ips=1407.5, p50=821.0)])
