@@ -204,7 +204,8 @@ class Cli:
             return (
                 f"bundle for {info['model']} (batch {info['batch']}, "
                 f"{info['weight_args']} weight files, {source}) -> {args[1]}; "
-                f"run with: native/pjrt_host run <plugin.so> {args[1]}"
+                f"serve with: native/pjrt_host serve <plugin.so> {args[1]} "
+                f"--dir <jpegs> (or one-shot: pjrt_host run)"
             )
         if cmd == "mesh-join":
             info = n.join_global_mesh()

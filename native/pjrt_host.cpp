@@ -23,22 +23,45 @@
 //       weights ship as raw files SEPARATE from the program, exactly like
 //       the SDFS deployment). create client -> compile -> stage args ->
 //       one execution -> print output shapes and leading values as JSON.
+//   pjrt_host serve <plugin.so> <bundle_dir> [--dir d] [--repeat N] ...
+//       the RESIDENT serving loop (reference: the native member loads its
+//       models once at boot and answers predict forever,
+//       services.rs:475-497,513-524): boot + compile + stage weights ONCE,
+//       then decode JPEGs with the in-process native decoder
+//       (image_pipeline.cpp, linked into this binary), stage u8 batches,
+//       execute, and emit top-1/prob — first over --dir if given, then
+//       request-per-line on stdin until EOF. --repeat N measures the
+//       sustained JPEG->top-1 rate with decode pipelined against device
+//       execution (same depth idea as run's --iters mode).
+//   pjrt_host stage <bundle_dir> --dir d --out staged.raw
+//       hermetic half of serve (no plugin, no TPU): decode --dir into the
+//       manifest's image-arg layout (pad by repetition like the exporter)
+//       and write the exact bytes serve would hand BufferFromHostBuffer —
+//       the decode->staging contract a CPU-only test can pin.
 //
 // Build: make pjrt_host (needs the PJRT C API header shipped inside the
 // tensorflow wheel; see Makefile's include-path discovery).
 
 #include <dlfcn.h>
+#include <dirent.h>
 #include <unistd.h>
 #include <ctime>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <cstdint>
+#include <algorithm>
 #include <array>
 #include <string>
 #include <vector>
 
 #include "xla/pjrt/c/pjrt_c_api.h"
+
+// Native JPEG decode + resize (image_pipeline.cpp, linked into this
+// binary) — the same code path the Python ctypes binding serves from.
+extern "C" int dmlc_decode_resize_batch(const char** paths, int n, int size,
+                                        uint8_t* out, int* status,
+                                        int n_threads);
 
 namespace {
 
@@ -319,14 +342,16 @@ PJRT_Error* DispatchExec(PJRT_LoadedExecutable* exec, PJRT_ExecuteOptions* eopts
   return err;
 }
 
+void DestroyBuffer(PJRT_Buffer* b) {
+  PJRT_Buffer_Destroy_Args bd;
+  std::memset(&bd, 0, sizeof(bd));
+  bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  bd.buffer = b;
+  g_api->PJRT_Buffer_Destroy(&bd);
+}
+
 void DestroyBuffers(const std::vector<PJRT_Buffer*>& bufs) {
-  for (PJRT_Buffer* b : bufs) {
-    PJRT_Buffer_Destroy_Args bd;
-    std::memset(&bd, 0, sizeof(bd));
-    bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
-    bd.buffer = b;
-    g_api->PJRT_Buffer_Destroy(&bd);
-  }
+  for (PJRT_Buffer* b : bufs) DestroyBuffer(b);
 }
 
 // Copy one buffer to host (true end-of-work barrier on tunnel plugins,
@@ -375,16 +400,57 @@ bool ParseArgSpec(const std::string& line, ArgSpec* out) {
   return true;
 }
 
-int Run(int argc, char** argv) {
-  const char* so_path = argv[2];
-  std::string bundle = argv[3];
-  const char* options_path = nullptr;
-  int iters = 1;
-  for (int i = 4; i + 1 < argc; i += 2) {
-    if (std::strcmp(argv[i], "--options") == 0) options_path = argv[i + 1];
-    else if (std::strcmp(argv[i], "--iters") == 0) iters = std::atoi(argv[i + 1]);
+// The bundle's staging contract: every executable input in flatten order,
+// plus which one is the image batch (the rank-4 u8 input) and its
+// [batch, size] geometry — what serve/stage decode into.
+struct Manifest {
+  std::vector<ArgSpec> args;
+  int image_arg = -1;
+  int64_t batch = 0;
+  int64_t size = 0;
+};
+
+bool LoadManifest(const std::string& bundle, Manifest* m) {
+  FILE* f = std::fopen((bundle + "/args.txt").c_str(), "rb");
+  if (!f) {
+    std::fprintf(stderr, "pjrt_host: no args.txt in %s\n", bundle.c_str());
+    return false;
   }
-  if (iters < 1) iters = 1;
+  char line[512];
+  while (std::fgets(line, sizeof(line), f)) {
+    std::string s(line);
+    while (!s.empty() && (s.back() == '\n' || s.back() == '\r')) s.pop_back();
+    if (s.empty() || s[0] == '#') continue;
+    ArgSpec a;
+    if (!ParseArgSpec(s, &a)) {
+      std::fprintf(stderr, "pjrt_host: bad args.txt line: %s\n", s.c_str());
+      std::fclose(f);
+      return false;
+    }
+    if (a.dt.type == PJRT_Buffer_Type_U8 && a.dims.size() == 4 &&
+        m->image_arg < 0) {
+      m->image_arg = static_cast<int>(m->args.size());
+      m->batch = a.dims[0];
+      m->size = a.dims[1];
+    }
+    m->args.push_back(std::move(a));
+  }
+  std::fclose(f);
+  return true;
+}
+
+// Boot the resident half: plugin + client + compiled executable + first
+// addressable device + output count. Shared by run and serve — the
+// load-once part of the reference's native member (services.rs:513-524).
+struct Host {
+  PJRT_Client* client = nullptr;
+  PJRT_LoadedExecutable* exec = nullptr;
+  PJRT_Device* device = nullptr;
+  size_t num_outputs = 0;
+};
+
+int Boot(const char* so_path, const char* options_path,
+         const std::string& bundle, Host* h) {
   std::string default_opts = bundle + "/client_options.txt";
   Options opts;
   if (!options_path) {
@@ -398,29 +464,6 @@ int Run(int argc, char** argv) {
     }
   }
   if (options_path && !LoadOptions(options_path, &opts)) return 1;
-  std::string program_path = bundle + "/program.mlir";
-  std::string copts_path = bundle + "/compile_options.pb";
-
-  // args.txt: one ArgSpec line per executable input, in flattened order.
-  std::vector<ArgSpec> arg_specs;
-  {
-    FILE* f = std::fopen((bundle + "/args.txt").c_str(), "rb");
-    if (!f) { std::fprintf(stderr, "pjrt_host: no args.txt in %s\n", bundle.c_str()); return 1; }
-    char line[512];
-    while (std::fgets(line, sizeof(line), f)) {
-      std::string s(line);
-      while (!s.empty() && (s.back() == '\n' || s.back() == '\r')) s.pop_back();
-      if (s.empty() || s[0] == '#') continue;
-      ArgSpec a;
-      if (!ParseArgSpec(s, &a)) {
-        std::fprintf(stderr, "pjrt_host: bad args.txt line: %s\n", s.c_str());
-        std::fclose(f);
-        return 1;
-      }
-      arg_specs.push_back(std::move(a));
-    }
-    std::fclose(f);
-  }
 
   std::string error;
   g_api = LoadApi(so_path, &error);
@@ -439,11 +482,12 @@ int Run(int argc, char** argv) {
   cargs.create_options = opts.values.data();
   cargs.num_options = opts.values.size();
   CHECK_PJRT(g_api->PJRT_Client_Create(&cargs));
-  PJRT_Client* client = cargs.client;
+  h->client = cargs.client;
 
   // Compile the StableHLO module with the Python-side-serialized options.
+  std::string program_path = bundle + "/program.mlir";
   std::vector<char> program = ReadFile(program_path.c_str());
-  std::vector<char> coptions = ReadFile(copts_path.c_str());
+  std::vector<char> coptions = ReadFile((bundle + "/compile_options.pb").c_str());
   PJRT_Program prog;
   std::memset(&prog, 0, sizeof(prog));
   prog.struct_size = PJRT_Program_STRUCT_SIZE;
@@ -456,28 +500,92 @@ int Run(int argc, char** argv) {
   PJRT_Client_Compile_Args kargs;
   std::memset(&kargs, 0, sizeof(kargs));
   kargs.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
-  kargs.client = client;
+  kargs.client = h->client;
   kargs.program = &prog;
   kargs.compile_options = coptions.data();
   kargs.compile_options_size = coptions.size();
   CHECK_PJRT(g_api->PJRT_Client_Compile(&kargs));
-  PJRT_LoadedExecutable* exec = kargs.executable;
-  std::fprintf(stderr, "pjrt_host: compiled %s (%zu bytes)\n", program_path.c_str(), program.size());
+  h->exec = kargs.executable;
+  std::fprintf(stderr, "pjrt_host: compiled %s (%zu bytes)\n",
+               program_path.c_str(), program.size());
 
-  // Stage every argument (weights from raw files, input zeros or file)
-  // onto the first addressable device.
   PJRT_Client_AddressableDevices_Args aargs;
   std::memset(&aargs, 0, sizeof(aargs));
   aargs.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
-  aargs.client = client;
+  aargs.client = h->client;
   CHECK_PJRT(g_api->PJRT_Client_AddressableDevices(&aargs));
   if (aargs.num_addressable_devices == 0) {
     std::fprintf(stderr, "pjrt_host: no addressable devices\n");
     return 1;
   }
+  h->device = aargs.addressable_devices[0];
 
-  std::vector<PJRT_Buffer*> in_bufs;
-  for (const ArgSpec& a : arg_specs) {
+  PJRT_Executable_NumOutputs_Args noargs;
+  std::memset(&noargs, 0, sizeof(noargs));
+  noargs.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+  {
+    PJRT_LoadedExecutable_GetExecutable_Args geargs;
+    std::memset(&geargs, 0, sizeof(geargs));
+    geargs.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+    geargs.loaded_executable = h->exec;
+    CHECK_PJRT(g_api->PJRT_LoadedExecutable_GetExecutable(&geargs));
+    noargs.executable = geargs.executable;
+    CHECK_PJRT(g_api->PJRT_Executable_NumOutputs(&noargs));
+  }
+  h->num_outputs = noargs.num_outputs;
+  return 0;
+}
+
+void ShutdownHost(Host* h) {
+  if (h->exec) {
+    PJRT_LoadedExecutable_Destroy_Args ed;
+    std::memset(&ed, 0, sizeof(ed));
+    ed.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+    ed.executable = h->exec;
+    g_api->PJRT_LoadedExecutable_Destroy(&ed);
+  }
+  if (h->client) {
+    PJRT_Client_Destroy_Args cd;
+    std::memset(&cd, 0, sizeof(cd));
+    cd.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+    cd.client = h->client;
+    g_api->PJRT_Client_Destroy(&cd);
+  }
+}
+
+// Stage one argument's host bytes onto the device. Returns null on failure
+// (error already printed). The host data must stay valid until the
+// returned buffer's done event fires; this helper awaits it, so callers
+// may reuse `data` immediately.
+PJRT_Buffer* StageBuffer(const Host& h, const ArgSpec& a, const void* data) {
+  PJRT_Client_BufferFromHostBuffer_Args bargs;
+  std::memset(&bargs, 0, sizeof(bargs));
+  bargs.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  bargs.client = h.client;
+  bargs.data = data;
+  bargs.type = a.dt.type;
+  bargs.dims = a.dims.data();
+  bargs.num_dims = a.dims.size();
+  bargs.host_buffer_semantics =
+      PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+  bargs.device = h.device;
+  PJRT_Error* err = g_api->PJRT_Client_BufferFromHostBuffer(&bargs);
+  if (err) {
+    std::fprintf(stderr, "pjrt_host: staging failed: %s\n", ErrMessage(err).c_str());
+    return nullptr;
+  }
+  if (AwaitEvent(bargs.done_with_host_buffer)) {
+    DestroyBuffer(bargs.buffer);
+    return nullptr;
+  }
+  return bargs.buffer;
+}
+
+// Stage every manifest argument from its raw file (zeros when file-less).
+// Returns nonzero on failure; fills `bufs` in manifest order.
+int StageManifestArgs(const Host& h, const Manifest& m, const std::string& bundle,
+                      std::vector<PJRT_Buffer*>* bufs) {
+  for (const ArgSpec& a : m.args) {
     std::vector<char> input(a.total * a.dt.bytes, 0);
     if (!a.file.empty()) {
       std::string path = bundle + "/" + a.file;
@@ -489,36 +597,33 @@ int Run(int argc, char** argv) {
       }
       input = std::move(raw);
     }
-    PJRT_Client_BufferFromHostBuffer_Args bargs;
-    std::memset(&bargs, 0, sizeof(bargs));
-    bargs.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
-    bargs.client = client;
-    bargs.data = input.data();
-    bargs.type = a.dt.type;
-    bargs.dims = a.dims.data();
-    bargs.num_dims = a.dims.size();
-    bargs.host_buffer_semantics =
-        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
-    bargs.device = aargs.addressable_devices[0];
-    CHECK_PJRT(g_api->PJRT_Client_BufferFromHostBuffer(&bargs));
-    if (AwaitEvent(bargs.done_with_host_buffer)) return 1;
-    in_bufs.push_back(bargs.buffer);
+    PJRT_Buffer* b = StageBuffer(h, a, input.data());
+    if (!b) return 1;
+    bufs->push_back(b);
   }
+  return 0;
+}
 
-  // Execute: 1 device, 1 argument.
-  PJRT_Executable_NumOutputs_Args noargs;
-  std::memset(&noargs, 0, sizeof(noargs));
-  noargs.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
-  {
-    PJRT_LoadedExecutable_GetExecutable_Args geargs;
-    std::memset(&geargs, 0, sizeof(geargs));
-    geargs.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
-    geargs.loaded_executable = exec;
-    CHECK_PJRT(g_api->PJRT_LoadedExecutable_GetExecutable(&geargs));
-    noargs.executable = geargs.executable;
-    CHECK_PJRT(g_api->PJRT_Executable_NumOutputs(&noargs));
+int Run(int argc, char** argv) {
+  const char* so_path = argv[2];
+  std::string bundle = argv[3];
+  const char* options_path = nullptr;
+  int iters = 1;
+  for (int i = 4; i + 1 < argc; i += 2) {
+    if (std::strcmp(argv[i], "--options") == 0) options_path = argv[i + 1];
+    else if (std::strcmp(argv[i], "--iters") == 0) iters = std::atoi(argv[i + 1]);
   }
-  size_t num_outputs = noargs.num_outputs;
+  if (iters < 1) iters = 1;
+
+  Manifest manifest;
+  if (!LoadManifest(bundle, &manifest)) return 1;
+
+  Host host;
+  if (Boot(so_path, options_path, bundle, &host)) return 1;
+
+  std::vector<PJRT_Buffer*> in_bufs;
+  if (StageManifestArgs(host, manifest, bundle, &in_bufs)) return 1;
+  size_t num_outputs = host.num_outputs;
 
   PJRT_ExecuteOptions eopts;
   std::memset(&eopts, 0, sizeof(eopts));
@@ -527,14 +632,14 @@ int Run(int argc, char** argv) {
   PJRT_Buffer* const* arg_lists[1] = {in_bufs.data()};
   std::vector<PJRT_Buffer*> out_list(num_outputs, nullptr);
   PJRT_Event* first_ev = nullptr;
-  CHECK_PJRT(DispatchExec(exec, &eopts, arg_lists, in_bufs.size(), &out_list, &first_ev));
+  CHECK_PJRT(DispatchExec(host.exec, &eopts, arg_lists, in_bufs.size(), &out_list, &first_ev));
   if (AwaitEvent(first_ev)) return 1;
 
   // Read back every output and report.
   std::printf("{\"outputs\": [");
   for (size_t i = 0; i < num_outputs; ++i) {
-    std::vector<char> host;
-    if (ReadbackBuffer(out_list[i], &host)) return 1;
+    std::vector<char> host_bytes;
+    if (ReadbackBuffer(out_list[i], &host_bytes)) return 1;
 
     PJRT_Buffer_ElementType_Args etargs;
     std::memset(&etargs, 0, sizeof(etargs));
@@ -543,24 +648,19 @@ int Run(int argc, char** argv) {
     CHECK_PJRT(g_api->PJRT_Buffer_ElementType(&etargs));
 
     std::printf("%s{\"bytes\": %zu, \"type\": %d, \"head\": [", i ? ", " : "",
-                host.size(), static_cast<int>(etargs.type));
+                host_bytes.size(), static_cast<int>(etargs.type));
     size_t shown = 0;
     if (etargs.type == PJRT_Buffer_Type_F32) {
-      const float* f = reinterpret_cast<const float*>(host.data());
-      for (; shown < 4 && shown < host.size() / 4; ++shown)
+      const float* f = reinterpret_cast<const float*>(host_bytes.data());
+      for (; shown < 4 && shown < host_bytes.size() / 4; ++shown)
         std::printf("%s%g", shown ? ", " : "", f[shown]);
     } else if (etargs.type == PJRT_Buffer_Type_S32) {
-      const int32_t* v = reinterpret_cast<const int32_t*>(host.data());
-      for (; shown < 4 && shown < host.size() / 4; ++shown)
+      const int32_t* v = reinterpret_cast<const int32_t*>(host_bytes.data());
+      for (; shown < 4 && shown < host_bytes.size() / 4; ++shown)
         std::printf("%s%d", shown ? ", " : "", v[shown]);
     }
     std::printf("]}");
-
-    PJRT_Buffer_Destroy_Args bd;
-    std::memset(&bd, 0, sizeof(bd));
-    bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
-    bd.buffer = out_list[i];
-    g_api->PJRT_Buffer_Destroy(&bd);
+    DestroyBuffer(out_list[i]);
   }
   std::printf("]}\n");
 
@@ -585,7 +685,7 @@ int Run(int argc, char** argv) {
     for (int i = 0; i < iters; ++i) {
       std::vector<PJRT_Buffer*> outs(num_outputs, nullptr);
       PJRT_Event* ev = nullptr;
-      CHECK_PJRT(DispatchExec(exec, &eopts, arg_lists, in_bufs.size(), &outs, &ev));
+      CHECK_PJRT(DispatchExec(host.exec, &eopts, arg_lists, in_bufs.size(), &outs, &ev));
       pending_bufs.push_back(std::move(outs));
       pending_events.push_back(ev);
       if (static_cast<int>(pending_events.size()) >= depth && await_oldest())
@@ -600,10 +700,10 @@ int Run(int argc, char** argv) {
     {
       std::vector<PJRT_Buffer*> outs(num_outputs, nullptr);
       PJRT_Event* ev = nullptr;
-      CHECK_PJRT(DispatchExec(exec, &eopts, arg_lists, in_bufs.size(), &outs, &ev));
+      CHECK_PJRT(DispatchExec(host.exec, &eopts, arg_lists, in_bufs.size(), &outs, &ev));
       if (AwaitEvent(ev)) return 1;
-      std::vector<char> host;
-      if (ReadbackBuffer(outs[0], &host)) return 1;
+      std::vector<char> host_bytes;
+      if (ReadbackBuffer(outs[0], &host_bytes)) return 1;
       DestroyBuffers(outs);
     }
     clock_gettime(CLOCK_MONOTONIC, &t1);
@@ -613,23 +713,369 @@ int Run(int argc, char** argv) {
                 total_iters, sec, sec * 1e3 / total_iters);
   }
 
-  for (PJRT_Buffer* b : in_bufs) {
-    PJRT_Buffer_Destroy_Args bd;
-    std::memset(&bd, 0, sizeof(bd));
-    bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
-    bd.buffer = b;
-    g_api->PJRT_Buffer_Destroy(&bd);
+  DestroyBuffers(in_bufs);
+  ShutdownHost(&host);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// serve / stage: the resident JPEG->top-1 loop and its hermetic half
+// ---------------------------------------------------------------------------
+
+bool HasJpegSuffix(const std::string& name) {
+  auto dot = name.rfind('.');
+  if (dot == std::string::npos) return false;
+  std::string ext = name.substr(dot + 1);
+  std::transform(ext.begin(), ext.end(), ext.begin(),
+                 [](unsigned char c) { return std::tolower(c); });
+  return ext == "jpg" || ext == "jpeg";
+}
+
+std::vector<std::string> ListJpegs(const std::string& dir) {
+  std::vector<std::string> out;
+  DIR* d = opendir(dir.c_str());
+  if (!d) {
+    std::fprintf(stderr, "pjrt_host: cannot open dir %s\n", dir.c_str());
+    return out;
   }
-  PJRT_LoadedExecutable_Destroy_Args ed;
-  std::memset(&ed, 0, sizeof(ed));
-  ed.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
-  ed.executable = exec;
-  g_api->PJRT_LoadedExecutable_Destroy(&ed);
-  PJRT_Client_Destroy_Args cd;
-  std::memset(&cd, 0, sizeof(cd));
-  cd.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
-  cd.client = client;
-  g_api->PJRT_Client_Destroy(&cd);
+  while (dirent* e = readdir(d)) {
+    std::string name = e->d_name;
+    if (HasJpegSuffix(name)) out.push_back(dir + "/" + name);
+  }
+  closedir(d);
+  std::sort(out.begin(), out.end());
+  return out;
+}
+
+// Decode up to `batch` paths into out[batch, size, size, 3] u8, padding by
+// repetition (the exporter's contract: pjrt_bundle.py pads with np.tile).
+// Returns the number of decode FAILURES among the real (unpadded) slots;
+// `failed` (optional) receives the per-real-slot failure flags so replies
+// can mark the affected entries instead of presenting zero-image results
+// as confident predictions.
+int DecodePadded(const std::vector<std::string>& paths, int64_t batch,
+                 int64_t size, uint8_t* out, int threads,
+                 std::vector<bool>* failed = nullptr) {
+  std::vector<const char*> cpaths(batch);
+  for (int64_t i = 0; i < batch; ++i)
+    cpaths[i] = paths[i % paths.size()].c_str();
+  std::vector<int> status(batch, 0);
+  dmlc_decode_resize_batch(cpaths.data(), static_cast<int>(batch),
+                           static_cast<int>(size), out, status.data(), threads);
+  int failures = 0;
+  if (failed) failed->assign(paths.size(), false);
+  for (size_t i = 0; i < paths.size() && i < static_cast<size_t>(batch); ++i) {
+    if (status[i] != 0) {
+      ++failures;
+      if (failed) (*failed)[i] = true;
+    }
+  }
+  return failures;
+}
+
+// Execute one staged image batch against the resident weights and read the
+// (top-1 index, top-1 prob) outputs back. Returns nonzero on failure.
+int ClassifyStaged(const Host& h, const Manifest& m,
+                   std::vector<PJRT_Buffer*>& args, PJRT_Buffer* image,
+                   std::vector<int32_t>* top1, std::vector<float>* prob) {
+  args[m.image_arg] = image;
+  PJRT_ExecuteOptions eopts;
+  std::memset(&eopts, 0, sizeof(eopts));
+  eopts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+  PJRT_Buffer* const* arg_lists[1] = {args.data()};
+  std::vector<PJRT_Buffer*> outs(h.num_outputs, nullptr);
+  PJRT_Event* ev = nullptr;
+  PJRT_Error* err = DispatchExec(h.exec, &eopts, arg_lists, args.size(), &outs, &ev);
+  if (err) {
+    std::fprintf(stderr, "pjrt_host: execute failed: %s\n", ErrMessage(err).c_str());
+    return 1;
+  }
+  if (AwaitEvent(ev)) return 1;
+  std::vector<char> idx_bytes, prob_bytes;
+  if (ReadbackBuffer(outs[0], &idx_bytes)) return 1;
+  if (outs.size() > 1 && ReadbackBuffer(outs[1], &prob_bytes)) return 1;
+  DestroyBuffers(outs);
+  top1->assign(reinterpret_cast<const int32_t*>(idx_bytes.data()),
+               reinterpret_cast<const int32_t*>(idx_bytes.data() + idx_bytes.size()));
+  prob->assign(reinterpret_cast<const float*>(prob_bytes.data()),
+               reinterpret_cast<const float*>(prob_bytes.data() + prob_bytes.size()));
+  return 0;
+}
+
+void PrintBatchResult(const std::vector<std::string>& files,
+                      const std::vector<int32_t>& top1,
+                      const std::vector<float>& prob,
+                      const std::vector<bool>& decode_failed) {
+  std::printf("{\"files\": [");
+  for (size_t i = 0; i < files.size(); ++i) {
+    auto slash = files[i].rfind('/');
+    std::string base = slash == std::string::npos ? files[i] : files[i].substr(slash + 1);
+    std::printf("%s\"%s\"", i ? ", " : "", JsonEscape(base).c_str());
+  }
+  std::printf("], \"top1\": [");
+  for (size_t i = 0; i < files.size() && i < top1.size(); ++i)
+    std::printf("%s%d", i ? ", " : "", top1[i]);
+  std::printf("], \"prob\": [");
+  for (size_t i = 0; i < files.size() && i < prob.size(); ++i)
+    std::printf("%s%.6g", i ? ", " : "", prob[i]);
+  std::printf("]");
+  // In-protocol failure marker: a zero-filled slot's "prediction" must not
+  // read as a confident answer to a stdout consumer (stderr notes are not
+  // part of the reply).
+  bool any = false;
+  for (bool f : decode_failed) any |= f;
+  if (any) {
+    std::printf(", \"decode_failed\": [");
+    bool first = true;
+    for (size_t i = 0; i < decode_failed.size(); ++i) {
+      if (!decode_failed[i]) continue;
+      std::printf("%s%zu", first ? "" : ", ", i);
+      first = false;
+    }
+    std::printf("]");
+  }
+  std::printf("}\n");
+  std::fflush(stdout);
+}
+
+// The hermetic half of serve: decode --dir into the manifest's image-arg
+// layout and write the raw bytes serve would stage. No plugin, no TPU — a
+// CPU-only test diffs this against the Python pipeline byte for byte.
+int Stage(int argc, char** argv) {
+  std::string bundle = argv[2];
+  const char* dir = nullptr;
+  const char* out_path = nullptr;
+  int threads = 0;
+  for (int i = 3; i + 1 < argc; i += 2) {
+    if (std::strcmp(argv[i], "--dir") == 0) dir = argv[i + 1];
+    else if (std::strcmp(argv[i], "--out") == 0) out_path = argv[i + 1];
+    else if (std::strcmp(argv[i], "--threads") == 0) threads = std::atoi(argv[i + 1]);
+  }
+  if (!dir || !out_path) {
+    std::fprintf(stderr, "pjrt_host: stage needs --dir and --out\n");
+    return 2;
+  }
+  Manifest m;
+  if (!LoadManifest(bundle, &m)) return 1;
+  if (m.image_arg < 0) {
+    std::fprintf(stderr, "pjrt_host: manifest has no u8 image input\n");
+    return 1;
+  }
+  std::vector<std::string> files = ListJpegs(dir);
+  if (files.empty()) {
+    std::fprintf(stderr, "pjrt_host: no JPEGs in %s\n", dir);
+    return 1;
+  }
+  if (static_cast<int64_t>(files.size()) > m.batch) files.resize(m.batch);
+  std::vector<uint8_t> staged(m.batch * m.size * m.size * 3);
+  int failures = DecodePadded(files, m.batch, m.size, staged.data(), threads);
+  FILE* f = std::fopen(out_path, "wb");
+  if (!f || std::fwrite(staged.data(), 1, staged.size(), f) != staged.size()) {
+    std::fprintf(stderr, "pjrt_host: cannot write %s\n", out_path);
+    if (f) std::fclose(f);
+    return 1;
+  }
+  std::fclose(f);
+  std::printf(
+      "{\"batch\": %lld, \"size\": %lld, \"files\": %zu, \"padded\": %lld, "
+      "\"decode_failures\": %d, \"bytes\": %zu}\n",
+      static_cast<long long>(m.batch), static_cast<long long>(m.size),
+      files.size(), static_cast<long long>(m.batch) - static_cast<long long>(files.size()),
+      failures, staged.size());
+  return failures ? 1 : 0;
+}
+
+// The resident serving loop (reference: services.rs:475-497 — load once,
+// answer predict forever). Boot + compile + stage weights ONCE; then:
+//   1. --dir: classify every JPEG under it, one JSON line per batch;
+//   2. --repeat N: N pipelined passes over the dir measuring the sustained
+//      native JPEG->top-1 rate (decode of batch k+1 overlaps execution of
+//      batch k — the serve-side analog of run's --iters pipeline);
+//   3. stdin: one request per line (whitespace-separated JPEG paths),
+//      answered with a JSON result line, until EOF.
+int Serve(int argc, char** argv) {
+  const char* so_path = argv[2];
+  std::string bundle = argv[3];
+  const char* options_path = nullptr;
+  const char* dir = nullptr;
+  int repeat = 0;
+  int threads = 0;
+  for (int i = 4; i + 1 < argc; i += 2) {
+    if (std::strcmp(argv[i], "--options") == 0) options_path = argv[i + 1];
+    else if (std::strcmp(argv[i], "--dir") == 0) dir = argv[i + 1];
+    else if (std::strcmp(argv[i], "--repeat") == 0) repeat = std::atoi(argv[i + 1]);
+    else if (std::strcmp(argv[i], "--threads") == 0) threads = std::atoi(argv[i + 1]);
+  }
+
+  if (repeat > 0 && !dir) {
+    std::fprintf(stderr,
+                 "pjrt_host: --repeat needs --dir (nothing to measure); "
+                 "refusing to fall through to the stdin loop\n");
+    return 2;
+  }
+
+  Manifest manifest;
+  if (!LoadManifest(bundle, &manifest)) return 1;
+  if (manifest.image_arg < 0) {
+    std::fprintf(stderr, "pjrt_host: manifest has no u8 image input to serve\n");
+    return 1;
+  }
+  const int64_t B = manifest.batch, S = manifest.size;
+
+  Host host;
+  if (Boot(so_path, options_path, bundle, &host)) return 1;
+
+  // Stage every argument once; the image slot's boot-time buffer (zeros or
+  // the export-time image.raw) is replaced per request.
+  std::vector<PJRT_Buffer*> args;
+  if (StageManifestArgs(host, manifest, bundle, &args)) return 1;
+  PJRT_Buffer* boot_image = args[manifest.image_arg];
+  std::fprintf(stderr,
+               "pjrt_host: serving batch=%lld size=%lld (weights resident, "
+               "native decode in-process)\n",
+               static_cast<long long>(B), static_cast<long long>(S));
+
+  std::vector<uint8_t> pixels(B * S * S * 3);
+  auto classify_paths = [&](const std::vector<std::string>& paths) -> int {
+    std::vector<bool> decode_failed;
+    int failures = DecodePadded(paths, B, S, pixels.data(), threads, &decode_failed);
+    if (failures)
+      std::fprintf(stderr, "pjrt_host: %d decode failure(s) in batch\n", failures);
+    PJRT_Buffer* image = StageBuffer(host, manifest.args[manifest.image_arg],
+                                     pixels.data());
+    if (!image) return 1;
+    std::vector<int32_t> top1;
+    std::vector<float> prob;
+    int rc = ClassifyStaged(host, manifest, args, image, &top1, &prob);
+    DestroyBuffer(image);
+    if (rc) return rc;
+    PrintBatchResult(paths, top1, prob, decode_failed);
+    return 0;
+  };
+
+  // Phase 1: classify the directory, batch by batch.
+  std::vector<std::string> files;
+  if (dir) {
+    files = ListJpegs(dir);
+    if (files.empty()) {
+      std::fprintf(stderr, "pjrt_host: no JPEGs in %s\n", dir);
+      return 1;
+    }
+    for (size_t s = 0; s < files.size(); s += B) {
+      std::vector<std::string> chunk(
+          files.begin() + s,
+          files.begin() + std::min(files.size(), s + static_cast<size_t>(B)));
+      if (classify_paths(chunk)) return 1;
+    }
+  }
+
+  // Phase 2: sustained-throughput passes, decode pipelined against device
+  // execution. Results are NOT read back per batch (a tunnel round trip
+  // per batch would measure the network); the final batch IS read back as
+  // the true end-of-work barrier, exactly like run's --iters mode.
+  if (dir && repeat > 0) {
+    const size_t depth = 2;
+    std::vector<PJRT_Buffer*> pending_images;
+    std::vector<std::vector<PJRT_Buffer*>> pending_outs;
+    std::vector<PJRT_Event*> pending_events;
+    auto await_oldest = [&]() -> int {
+      if (AwaitEvent(pending_events.front())) return 1;
+      pending_events.erase(pending_events.begin());
+      DestroyBuffers(pending_outs.front());
+      pending_outs.erase(pending_outs.begin());
+      DestroyBuffer(pending_images.front());
+      pending_images.erase(pending_images.begin());
+      return 0;
+    };
+    PJRT_ExecuteOptions eopts;
+    std::memset(&eopts, 0, sizeof(eopts));
+    eopts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+    long long images = 0;
+    long long decode_failures = 0;
+    struct timespec t0, t1;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+    for (int pass = 0; pass < repeat; ++pass) {
+      for (size_t s = 0; s < files.size(); s += B) {
+        std::vector<std::string> chunk(
+            files.begin() + s,
+            files.begin() + std::min(files.size(), s + static_cast<size_t>(B)));
+        // Decode on the host WHILE the previously dispatched batches run.
+        decode_failures += DecodePadded(chunk, B, S, pixels.data(), threads);
+        PJRT_Buffer* image =
+            StageBuffer(host, manifest.args[manifest.image_arg], pixels.data());
+        if (!image) return 1;
+        args[manifest.image_arg] = image;
+        PJRT_Buffer* const* arg_lists[1] = {args.data()};
+        std::vector<PJRT_Buffer*> outs(host.num_outputs, nullptr);
+        PJRT_Event* ev = nullptr;
+        PJRT_Error* err =
+            DispatchExec(host.exec, &eopts, arg_lists, args.size(), &outs, &ev);
+        if (err) {
+          std::fprintf(stderr, "pjrt_host: execute failed: %s\n",
+                       ErrMessage(err).c_str());
+          return 1;
+        }
+        pending_images.push_back(image);
+        pending_outs.push_back(std::move(outs));
+        pending_events.push_back(ev);
+        images += chunk.size();
+        if (pending_events.size() >= depth && await_oldest()) return 1;
+      }
+    }
+    // Drain all but the last; read the last batch's top-1 back as the
+    // barrier that proves the work actually finished on-device.
+    while (pending_events.size() > 1)
+      if (await_oldest()) return 1;
+    if (!pending_events.empty()) {
+      if (AwaitEvent(pending_events.front())) return 1;
+      std::vector<char> barrier;
+      if (ReadbackBuffer(pending_outs.front()[0], &barrier)) return 1;
+      DestroyBuffers(pending_outs.front());
+      DestroyBuffer(pending_images.front());
+      pending_events.clear();
+      pending_outs.clear();
+      pending_images.clear();
+    }
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    double sec = (t1.tv_sec - t0.tv_sec) + (t1.tv_nsec - t0.tv_nsec) * 1e-9;
+    // decode_failures keeps the rate honest: a zero-filled slot was
+    // classified but was not a successful JPEG->top-1 (stage exits 1 on
+    // failures; this reports them in-protocol instead).
+    std::printf(
+        "{\"images\": %lld, \"total_s\": %.4f, \"jpeg_to_top1_img_s\": %.1f, "
+        "\"batch\": %lld, \"passes\": %d, \"decode_failures\": %lld}\n",
+        images, sec, images / sec, static_cast<long long>(B), repeat,
+        decode_failures);
+    std::fflush(stdout);
+  }
+
+  // Phase 3: the long-lived request loop. One line = one predict request
+  // (whitespace-separated JPEG paths, up to the export batch); EOF ends
+  // the process. This is the reference's `predict` service surface
+  // (services.rs:475-497) with the model resident from boot.
+  char line[65536];
+  while (std::fgets(line, sizeof(line), stdin)) {
+    std::vector<std::string> paths;
+    for (char* tok = std::strtok(line, " \t\r\n"); tok;
+         tok = std::strtok(nullptr, " \t\r\n"))
+      paths.push_back(tok);
+    if (paths.empty()) continue;
+    if (static_cast<int64_t>(paths.size()) > B) {
+      std::printf("{\"error\": \"request of %zu images exceeds batch %lld\"}\n",
+                  paths.size(), static_cast<long long>(B));
+      std::fflush(stdout);
+      continue;
+    }
+    if (classify_paths(paths)) {
+      // A failed execute is fatal (client state unknown); a decode failure
+      // was already reported per-slot and the batch still answered.
+      return 1;
+    }
+  }
+
+  args[manifest.image_arg] = boot_image;
+  DestroyBuffers(args);
+  ShutdownHost(&host);
   return 0;
 }
 
@@ -639,10 +1085,18 @@ int main(int argc, char** argv) {
   if (argc >= 3 && std::strcmp(argv[1], "probe") == 0)
     return Probe(argv[2], argc > 3 ? argv[3] : nullptr);
   if (argc >= 4 && std::strcmp(argv[1], "run") == 0) return Run(argc, argv);
+  if (argc >= 4 && std::strcmp(argv[1], "serve") == 0) return Serve(argc, argv);
+  if (argc >= 3 && std::strcmp(argv[1], "stage") == 0) return Stage(argc, argv);
   std::fprintf(stderr,
                "usage:\n"
                "  pjrt_host probe <plugin.so> [client_options.txt]\n"
                "  pjrt_host run <plugin.so> <bundle_dir> [--options f] [--iters N]\n"
+               "  pjrt_host serve <plugin.so> <bundle_dir> [--options f] [--dir d]\n"
+               "                  [--repeat N] [--threads N]\n"
+               "    resident loop: --dir classified batch-wise, --repeat N timed\n"
+               "    pipelined passes, then one predict request per stdin line\n"
+               "  pjrt_host stage <bundle_dir> --dir d --out staged.raw\n"
+               "    hermetic: decode into the manifest's image layout, no TPU\n"
                "    bundle: program.mlir + compile_options.pb + args.txt manifest\n");
   return 2;
 }

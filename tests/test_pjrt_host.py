@@ -43,7 +43,102 @@ def host_binary():
 def test_usage_exit(host_binary):
     r = subprocess.run([str(host_binary)], capture_output=True, text=True)
     assert r.returncode == 2
-    assert "probe" in r.stderr and "run" in r.stderr
+    for verb in ("probe", "run", "serve", "stage"):
+        assert verb in r.stderr
+
+
+class TestStageContract:
+    """`pjrt_host stage` is the hermetic half of the resident serve loop:
+    it decodes a directory of JPEGs into the manifest's image-arg layout —
+    the exact bytes `serve` hands BufferFromHostBuffer. Pinned here against
+    the Python-side decode paths with no plugin and no TPU; the live serve
+    transcript (real TPU, value parity, sustained img/s) is recorded in
+    docs/PJRT_HOST.md."""
+
+    @pytest.fixture(scope="class")
+    def staged(self, host_binary, tmp_path_factory):
+        import tiny_model  # noqa: F401
+
+        from dmlc_tpu.models.pjrt_bundle import export_bundle
+
+        out = tmp_path_factory.mktemp("bundle")
+        export_bundle("tinynet", 8, out)
+        raw = out / "staged.raw"
+        photos = REPO / "tests" / "fixtures" / "photos"
+        r = subprocess.run(
+            [str(host_binary), "stage", str(out), "--dir", str(photos),
+             "--out", str(raw)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        return json.loads(r.stdout), raw, photos
+
+    def test_manifest_geometry_and_padding(self, staged):
+        meta, raw, photos = staged
+        n_photos = len(list(photos.glob("*.jpg")))
+        assert meta["batch"] == 8 and meta["files"] == n_photos
+        assert meta["padded"] == 8 - n_photos
+        assert meta["decode_failures"] == 0
+        assert raw.stat().st_size == meta["bytes"] == 8 * meta["size"] ** 2 * 3
+
+    def test_bytes_match_native_decode_and_tile_padding(self, staged):
+        """The staged bytes must be EXACTLY what the in-process decoder
+        produces (same C code path as the ctypes binding) with the
+        exporter's repeat-padding — so serve's device input is the same
+        tensor the Python cluster path would stage for these files."""
+        import numpy as np
+
+        from dmlc_tpu import native
+
+        if not native.available():
+            pytest.skip("native decode library not built")
+        meta, raw, photos = staged
+        files = sorted(str(p) for p in photos.glob("*.jpg"))
+        got = np.frombuffer(raw.read_bytes(), np.uint8).reshape(
+            meta["batch"], meta["size"], meta["size"], 3
+        )
+        ref, status = native.decode_resize_batch(files, size=meta["size"])
+        assert not status.any()
+        np.testing.assert_array_equal(got[: len(files)], ref)
+        reps = -(-meta["batch"] // len(files))
+        np.testing.assert_array_equal(
+            got[len(files):], np.tile(ref, (reps, 1, 1, 1))[len(files): meta["batch"]]
+        )
+
+    def test_bytes_near_pil_reference(self, staged):
+        """Accuracy parity transfers: the staged pixels stay within the
+        JPEG-noise tolerance of the PIL decode the torch-parity tests are
+        built on (same bound ops/preprocess.load_batch documents)."""
+        import numpy as np
+
+        meta, raw, photos = staged
+        files = sorted(str(p) for p in photos.glob("*.jpg"))
+        got = np.frombuffer(raw.read_bytes(), np.uint8).reshape(
+            meta["batch"], meta["size"], meta["size"], 3
+        )[: len(files)]
+        from dmlc_tpu.ops import preprocess as pp
+
+        pil = pp.load_batch(files, size=meta["size"], backend="pil")
+        diff = np.abs(got.astype(np.int32) - pil.astype(np.int32))
+        assert diff.mean() < 0.5
+
+    def test_stage_requires_dir_and_out(self, host_binary, tmp_path):
+        r = subprocess.run(
+            [str(host_binary), "stage", str(tmp_path)],
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 2 and "--dir" in r.stderr
+
+    def test_stage_empty_dir_fails_loudly(self, host_binary, staged, tmp_path):
+        meta, raw, _ = staged
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        r = subprocess.run(
+            [str(host_binary), "stage", str(raw.parent), "--dir", str(empty),
+             "--out", str(tmp_path / "x.raw")],
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 1 and "no JPEGs" in r.stderr
 
 
 def test_probe_bad_plugin_reports_json(host_binary, tmp_path):
@@ -194,7 +289,7 @@ def test_cli_export_bundle_verb(tmp_path):
             batch_size = 4
 
     out = Cli(StubNode()).run_command(f"export-bundle tinynet {tmp_path / 'b'}")
-    assert "bundle for tinynet" in out and "pjrt_host run" in out
+    assert "bundle for tinynet" in out and "pjrt_host serve" in out
     for name in ("program.mlir", "args.txt", "compile_options.pb", "client_options.txt"):
         assert (tmp_path / "b" / name).exists()
     assert "random-init" in out  # stub node has no SDFS weights
