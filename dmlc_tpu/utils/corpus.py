@@ -24,6 +24,84 @@ def write_synset_words(path: str | Path, n_classes: int) -> Path:
     return path
 
 
+def _reusable(
+    root: Path, n_classes: int, images_per_class: int, kind: str
+) -> tuple[Path, Path] | None:
+    """Existing corpora with the right shape AND kind are reused, not
+    regenerated. Reuse only when BOTH dimensions match: a corpus with fewer
+    images per class than requested would silently shrink whatever
+    measurement asked for this shape. The kind marker keeps the two
+    generators from adopting each other's output at a shared root — an
+    i.i.d. corpus reused by generate_learnable would cap training at
+    chance with no hint why (a pre-marker corpus counts as "iid", which is
+    what every pre-marker corpus was)."""
+    data_dir = root / "train"
+    synset_path = root / "synset_words.txt"
+    if not (synset_path.exists() and data_dir.exists()):
+        return None
+    marker = root / ".corpus_kind"
+    existing_kind = marker.read_text().strip() if marker.exists() else "iid"
+    if existing_kind != kind:
+        return None
+    dirs = [d for d in data_dir.iterdir() if d.is_dir()]
+    if len(dirs) >= n_classes and all(
+        sum(1 for f in d.iterdir() if f.is_file()) >= images_per_class
+        for d in dirs[:n_classes]
+    ):
+        return data_dir, synset_path
+    return None
+
+
+def generate_learnable(
+    root: str | Path,
+    n_classes: int = 40,
+    images_per_class: int = 8,
+    size: int = 32,
+    seed: int = 0,
+    noise: int = 28,
+    quality: int = 90,
+) -> tuple[Path, Path]:
+    """A corpus a model can actually LEARN: every image of class ``i`` is a
+    class-specific low-frequency pattern (deterministic in ``i``) plus
+    per-image noise, JPEG-encoded. ``img0.jpg`` in each class directory is
+    the held-out sample the cluster's predict path evaluates on
+    (ops/preprocess.class_image_path picks the first file) — train on
+    ``img1..`` and the jobs report's accuracy measures generalization to
+    an unseen image of each class, not memorization.
+
+    ``generate`` (below) keeps the reference fixture's *shape* with
+    unlearnable i.i.d. images; this variant exists for the train→publish→
+    hot-swap→accuracy loop (reference ships pretrained checkpoints and
+    reports live accuracy, services.rs:74-80,139-144 — here the framework
+    trains the checkpoint itself). Same layout, same reuse rule.
+    """
+    from PIL import Image
+
+    root = Path(root)
+    reuse = _reusable(root, n_classes, images_per_class, "learnable")
+    if reuse is not None:
+        return reuse
+
+    data_dir = root / "train"
+    synset_path = write_synset_words(root / "synset_words.txt", n_classes)
+    rng = np.random.default_rng(seed)
+    low = 4  # class signature lives in the lowest frequencies
+    for i in range(n_classes):
+        d = data_dir / f"n{i:08d}"
+        d.mkdir(parents=True, exist_ok=True)
+        sig_rng = np.random.default_rng(10_000 + i)  # per-class, not per-run
+        base = Image.fromarray(
+            sig_rng.integers(40, 216, (low, low, 3), np.uint8)
+        ).resize((size, size), Image.BILINEAR)
+        base = np.asarray(base, dtype=np.int16)
+        for j in range(images_per_class):
+            jitter = rng.integers(-noise, noise + 1, (size, size, 3), np.int16)
+            im = np.clip(base + jitter, 0, 255).astype(np.uint8)
+            Image.fromarray(im).save(d / f"img{j}.jpg", quality=quality)
+    (root / ".corpus_kind").write_text("learnable\n")
+    return data_dir, synset_path
+
+
 def generate(
     root: str | Path,
     n_classes: int = 100,
@@ -37,25 +115,18 @@ def generate(
     Layout: ``root/train/n{i:08d}/img{j}.jpg`` + ``root/synset_words.txt``.
     Images are smooth random fields (not pure noise) so JPEG encode/decode
     behaves like photographs rather than degenerate high-entropy blocks.
-    Existing corpora with the right shape are reused, not regenerated.
+    Existing corpora with the right shape and kind are reused (see
+    ``_reusable``), not regenerated.
     """
     from PIL import Image
 
     root = Path(root)
-    data_dir = root / "train"
-    synset_path = root / "synset_words.txt"
-    if synset_path.exists() and data_dir.exists():
-        dirs = [d for d in data_dir.iterdir() if d.is_dir()]
-        # Reuse only when BOTH dimensions match: a corpus with fewer images
-        # per class than requested would silently shrink whatever measurement
-        # asked for this shape (e.g. the bench's multi-batch overlap run).
-        if len(dirs) >= n_classes and all(
-            sum(1 for f in d.iterdir() if f.is_file()) >= images_per_class
-            for d in dirs[:n_classes]
-        ):
-            return data_dir, synset_path
+    reuse = _reusable(root, n_classes, images_per_class, "iid")
+    if reuse is not None:
+        return reuse
 
-    write_synset_words(synset_path, n_classes)
+    data_dir = root / "train"
+    synset_path = write_synset_words(root / "synset_words.txt", n_classes)
     rng = np.random.default_rng(seed)
     low = max(8, size // 8)
     for i in range(n_classes):
@@ -67,4 +138,5 @@ def generate(
             base = rng.integers(0, 256, (low, low, 3), np.uint8)
             im = Image.fromarray(base).resize((size, size), Image.BILINEAR)
             im.save(d / f"img{j}.jpg", quality=quality)
+    (root / ".corpus_kind").write_text("iid\n")
     return data_dir, synset_path
