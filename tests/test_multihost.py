@@ -345,10 +345,10 @@ GANG_WORKER = textwrap.dedent(
     import jax
     jax.config.update("jax_platforms", "cpu")
 
-    rank, coord, member_port, corpus_dir = (
-        int(sys.argv[1]), sys.argv[2], int(sys.argv[3]), sys.argv[4]
+    rank, world, coord, member_port, corpus_dir = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], int(sys.argv[4]), sys.argv[5]
     )
-    jax.distributed.initialize(coordinator_address=coord, num_processes=2, process_id=rank)
+    jax.distributed.initialize(coordinator_address=coord, num_processes=world, process_id=rank)
 
     import jax.numpy as jnp
     from flax import linen as nn
@@ -370,11 +370,11 @@ GANG_WORKER = textwrap.dedent(
     registry.register(registry.ModelSpec(
         "tiny_gang", lambda num_classes, dtype: TinyNet(num_classes, dtype), 32, 12))
 
-    # Same seed on both ranks == replicated weights (production: SDFS).
+    # Same seed on every rank == replicated weights (production: SDFS).
     model = TinyNet(12)
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False)
 
-    mesh = mesh_lib.make_mesh({"dp": 2})  # spans both processes
+    mesh = mesh_lib.make_mesh({"dp": world})  # spans all processes
     backend = EngineBackend(
         "tiny_gang", corpus_dir, batch_size=8,
         mesh=mesh, variables=variables, dtype=jnp.float32,
@@ -387,6 +387,115 @@ GANG_WORKER = textwrap.dedent(
 )
 
 
+def _spawn_gang(script, world, ports, data_dir, env):
+    """Start a `world`-process jax.distributed gang of GANG_WORKER members:
+    ports[0] is the coordinator, ports[1:] the member RPC ports. Returns
+    the Popen list once every member printed its ready line; on a failed
+    start the WHOLE gang is torn down before raising (the caller's finally
+    never sees these processes, and survivors would otherwise sit wedged
+    in the coordinator barrier for the rest of the pytest run)."""
+    coord = f"127.0.0.1:{ports[0]}"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(rank), str(world), coord,
+             str(ports[1 + rank]), str(data_dir)],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+            cwd=REPO_ROOT,
+            text=True,
+        )
+        for rank in range(world)
+    ]
+
+    def failed_stderr(p):
+        # Reading a LIVE worker's stderr pipe blocks until EOF; kill first
+        # so the diagnostic read is bounded.
+        p.kill()
+        try:
+            return p.stderr.read()[-3000:]
+        except Exception:
+            return "<stderr unavailable>"
+
+    try:
+        for p in procs:  # wait for all servers (compile included)
+            for _ in range(50):  # Gloo logs its own lines to stdout first
+                line = p.stdout.readline()
+                assert line, f"worker died:\n{failed_stderr(p)}"
+                if line.lstrip().startswith("{"):
+                    assert json.loads(line)["ready"]
+                    break
+            else:
+                raise AssertionError(f"no ready line from worker: {failed_stderr(p)}")
+    except BaseException:
+        _stop_gang(procs)
+        raise
+    return procs
+
+
+def _stop_gang(procs):
+    for p in procs:
+        try:
+            p.stdin.close()
+        except Exception:
+            pass
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def _free_ports(n):
+    import socket as socket_mod
+
+    ports = []
+    for _ in range(n):
+        with socket_mod.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+    return ports
+
+
+def _gang_ground_truth(data_dir, synsets):
+    """Local forward with GANG_WORKER's exact model + weights + decode:
+    [(synset, expected_class), ...] — `job.correct` then scores the gang's
+    reassembled predictions against this reference row for row. ONE
+    definition (matching GANG_WORKER's inline TinyNet) so the reference
+    cannot silently diverge from what the gang serves."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import linen as nn
+
+    from dmlc_tpu.ops import preprocess as pp
+
+    class TinyNet(nn.Module):
+        num_classes: int
+        dtype: object = jnp.float32
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.Conv(8, (3, 3), dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = x.mean(axis=(1, 2))
+            return nn.Dense(self.num_classes, dtype=self.dtype)(x)
+
+    model = TinyNet(12)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False
+    )
+    paths = [pp.class_image_path(data_dir, s) for s in synsets]
+    batch = pp.load_batch(paths, size=32)
+    mean, std = pp.stats_for_model("tiny_gang")
+    x = (batch.astype(np.float32) / 255.0 - mean) / std
+    expect = np.argmax(
+        np.asarray(model.apply(variables, jnp.asarray(x), train=False)), -1
+    )
+    return [(s, int(expect[i])) for i, s in enumerate(synsets)]
+
+
 def test_scheduler_gang_dispatch_two_process_collective(tmp_path):
     """VERDICT r2 item 3, scheduler-level: the leader's JobScheduler drives
     distributed SPMD inference end-to-end — ONE shard range dispatched to
@@ -395,20 +504,11 @@ def test_scheduler_gang_dispatch_two_process_collective(tmp_path):
     exactly-once at the leader, and the jobs report showing the mesh group
     serving shards collectively. Ground truth: the same model + images
     through a local forward in this process."""
-    import socket as socket_mod
-
-    import numpy as np
-
     from dmlc_tpu.scheduler.jobs import JobScheduler
     from dmlc_tpu.cluster.rpc import TcpRpc
     from dmlc_tpu.utils import corpus
 
-    ports = []
-    for _ in range(3):
-        with socket_mod.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            ports.append(s.getsockname()[1])
-    coord = f"127.0.0.1:{ports[0]}"
+    ports = _free_ports(3)
     member_addrs = [f"127.0.0.1:{p}" for p in ports[1:]]
 
     data_dir, synset_path = corpus.generate(
@@ -422,58 +522,11 @@ def test_scheduler_gang_dispatch_two_process_collective(tmp_path):
     env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     script = tmp_path / "gang_worker.py"
     script.write_text(GANG_WORKER)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(rank), coord, str(ports[1 + rank]), str(data_dir)],
-            stdin=subprocess.PIPE,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            env=env,
-            cwd=REPO_ROOT,
-            text=True,
-        )
-        for rank in range(2)
-    ]
+    procs = _spawn_gang(script, 2, ports, data_dir, env)
     try:
-        for p in procs:  # wait for both servers (compile included)
-            for _ in range(50):  # Gloo logs its own lines to stdout first
-                line = p.stdout.readline()
-                assert line, f"worker died:\n{p.stderr.read()[-3000:]}"
-                if line.lstrip().startswith("{"):
-                    assert json.loads(line)["ready"]
-                    break
-            else:
-                raise AssertionError(f"no ready line from worker: {p.stderr.read()[-3000:]}")
-
-        # Ground truth via a local forward on the same weights + images.
-        import jax
-        import jax.numpy as jnp
-        from flax import linen as nn
-
-        from dmlc_tpu.ops import preprocess as pp
-
-        class TinyNet(nn.Module):
-            num_classes: int
-            dtype: object = jnp.float32
-
-            @nn.compact
-            def __call__(self, x, train=False):
-                x = nn.Conv(8, (3, 3), dtype=self.dtype)(x)
-                x = nn.relu(x)
-                x = x.mean(axis=(1, 2))
-                return nn.Dense(self.num_classes, dtype=self.dtype)(x)
-
-        model = TinyNet(12)
-        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False)
-        paths = [pp.class_image_path(data_dir, s) for s in synsets]
-        batch = pp.load_batch(paths, size=32)
-        mean, std = pp.stats_for_model("tiny_gang")
-        x = (batch.astype(np.float32) / 255.0 - mean) / std
-        expect = np.argmax(np.asarray(model.apply(variables, jnp.asarray(x), train=False)), -1)
-
         # Truth == locally-computed prediction: job.correct then asserts the
         # gang's reassembled predictions match the reference row-for-row.
-        queries = [(s, int(expect[i])) for i, s in enumerate(synsets)]
+        queries = _gang_ground_truth(data_dir, synsets)
         sched = JobScheduler(
             TcpRpc(),
             lambda: list(member_addrs),
@@ -502,12 +555,97 @@ def test_scheduler_gang_dispatch_two_process_collective(tmp_path):
         # finished jobs' pools; the gang_shards count is the collective
         # evidence.)
     finally:
-        for p in procs:
-            try:
-                p.stdin.close()
-            except Exception:
-                pass
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
+        _stop_gang(procs)
+
+
+def test_scheduler_gang_four_process_kill_and_reform(tmp_path):
+    """VERDICT r4 next #8: gang serving at n=4 with a mid-job kill. One
+    collective shard completes on a REAL 4-process jax.distributed mesh;
+    then a rank is killed mid-job. The whole-gang retry fails bounded (the
+    collective needs every process; unreachability requeues the shard with
+    no partial credit and trips no breaker), exactly-once holds, and after
+    the operator re-forms the gang — fresh 4-process runtime, new
+    addresses, the leader's scheduler keeping its cursor — the SAME job
+    resumes from the requeued shard and completes with every prediction
+    matching the local reference exactly once. Extends the reference's
+    resume semantics (services.rs:212-240) to collective serving."""
+    from dmlc_tpu.cluster.rpc import TcpRpc
+    from dmlc_tpu.scheduler.jobs import JobScheduler
+    from dmlc_tpu.utils import corpus
+
+    data_dir, synset_path = corpus.generate(
+        tmp_path / "corpus", n_classes=12, images_per_class=1, size=32
+    )
+    synsets = [line.split()[0] for line in synset_path.read_text().splitlines()]
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # 1 local device per process
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    script = tmp_path / "gang_worker.py"
+    script.write_text(GANG_WORKER)
+
+    ports = _free_ports(5)
+    member_addrs = [f"127.0.0.1:{p}" for p in ports[1:]]
+    group = {a: r for r, a in enumerate(member_addrs)}
+    procs = _spawn_gang(script, 4, ports, data_dir, env)
+    procs2 = []
+    try:
+        queries = _gang_ground_truth(data_dir, synsets)
+
+        # Scheduler state persists across gang generations: the members
+        # callable and mesh_group read mutable views the test updates when
+        # the gang re-forms (production: membership + mesh-join refresh).
+        sched = JobScheduler(
+            TcpRpc(),
+            lambda: list(member_addrs),
+            jobs={"tiny_gang": queries},
+            shard_size=8,
+            mesh_group=lambda: dict(group),
+            shard_timeout_s=15.0,
+        )
+        sched.is_leading = True
+        sched._start({})
+        sched.assign_once()
+
+        # Shard 1 (offsets 0..7) completes collectively on all 4 ranks.
+        done = sched.dispatch_once("tiny_gang")
+        job = sched.jobs["tiny_gang"]
+        assert done == 8 and job.finished == 8
+        assert job.report()["gang_shards"] == 1
+        assert job.report()["gang_staged_ranks"] == 4  # prefetch on all 4
+
+        # Mid-job kill: rank 3 dies. The next collective shard must fail
+        # whole (no partial credit), requeue, and leave the cursor intact.
+        procs[3].kill()
+        procs[3].wait(timeout=10)
+        done = sched.dispatch_once("tiny_gang")
+        assert done == 0
+        assert job.finished == 8 and not job.done
+        assert job.retry_q and job.retry_q[0][0] == 8  # whole-shard requeue
+        assert job.outstanding == {}  # nothing stranded
+        # Unreachability is weather, not a config error: the breaker that
+        # stops method-level refusals must NOT have advanced toward
+        # stopping this job.
+        assert job.running and job.gang_consec_failures == 0
+
+        # Re-form: fresh 4-process runtime on new ports (the survivors of
+        # the old gang are wedged in a dead collective and are torn down).
+        _stop_gang(procs)
+        ports2 = _free_ports(5)
+        procs2 = _spawn_gang(script, 4, ports2, data_dir, env)
+        member_addrs[:] = [f"127.0.0.1:{p}" for p in ports2[1:]]
+        group.clear()
+        group.update({a: r for r, a in enumerate(member_addrs)})
+        sched.assign_once()  # re-assigns the job onto the new gang
+
+        sched.run_to_completion(max_rounds=100)
+        rep = job.report()
+        assert job.done and job.finished == len(queries)
+        # Exactly-once through the kill + re-form: every query answered
+        # once, every answer matching the local reference.
+        assert job.correct == len(queries), rep
+        assert rep["gang_shards"] == 2  # one per gang generation
+    finally:
+        _stop_gang(procs)
+        _stop_gang(procs2)
