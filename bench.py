@@ -174,16 +174,20 @@ def annotate_flash_entries(flash: dict, old_flash: dict) -> dict:
     noisy 20-iter window must not commit a 'flash 1.45x slower than dense'
     artifact the kernel docstring cites as parity evidence (review r4)."""
     return _annotate_rate_entries(
-        flash, old_flash, ("flash_ms", "dense_ms"), min, 2
+        flash, old_flash, ("flash_ms", "dense_ms", "auto_ms"), min, 2
     )
 
 
 def annotate_e2e(e2e: dict | None, old_e2e: dict | None) -> dict | None:
     """Degradation guard for the e2e section, mirroring configs/curve/flash:
     each rate field tracks its best-known (MAXIMUM), and a reading >2x
-    below best flags the section so merge_detail keeps the previous healthy
-    one — round 4: a degraded window wrote e2e 46 img/s / overlap 0.8x over
-    a healthy 113 / 1.37 with no guard on this section."""
+    below best flags it — round 4: a degraded window wrote e2e 46 img/s /
+    overlap 0.8x over a healthy 113 / 1.37 with no guard on this section.
+    Flags are PER LEG (``degraded_legs``), because the section mixes
+    host-only rates (decode_*) with tunnel-crossing rates (e2e/serial): a
+    bad tunnel window must not discard a healthy host-side improvement
+    captured in the same run (round 5: decode_only tripled in a window
+    whose e2e leg collapsed)."""
     if not e2e:
         return e2e
     e2e = dict(e2e)
@@ -193,7 +197,7 @@ def annotate_e2e(e2e: dict | None, old_e2e: dict | None) -> dict | None:
         # best-known seeded) by another model's history: a legitimately
         # slower model would be flagged forever and never recorded.
         old_e2e = {}
-    degraded = False
+    degraded_legs = []
     for leg in ("e2e_img_s", "serial_img_s", "decode_only_img_s", "decode_raw_img_s"):
         cur = e2e.get(leg)
         candidates = [x for x in (cur, old_e2e.get(f"best_{leg}"), old_e2e.get(leg)) if x]
@@ -202,9 +206,10 @@ def annotate_e2e(e2e: dict | None, old_e2e: dict | None) -> dict | None:
         best = max(candidates)
         e2e[f"best_{leg}"] = round(best, 1)
         if cur is not None and cur < best / 2.0:
-            degraded = True
-    if degraded:
+            degraded_legs.append(leg)
+    if degraded_legs:
         e2e["degraded_vs_history"] = True
+        e2e["degraded_legs"] = degraded_legs
     return e2e
 
 
@@ -216,7 +221,7 @@ def annotate_train_entries(train: dict, old_train: dict) -> dict:
     return _annotate_rate_entries(
         train, old_train,
         ("images_per_sec_per_chip", "tokens_per_sec_per_chip"), max, 1,
-        config_keys=("batch", "seq", "chips"),
+        config_keys=("batch", "seq", "chips", "heads"),
     )
 
 
@@ -353,14 +358,43 @@ def merge_detail(new: dict, old: dict) -> dict:
         and new_e2e.get("degraded_vs_history")
         and not old_e2e.get("degraded_vs_history")
     ):
-        new_e2e = None  # keep the healthy committed section (stamped stale)
+        # Per-leg repair: keep this run's healthy legs, splice the
+        # previous committed value into each collapsed leg, and name the
+        # repaired legs so the artifact self-documents the mix. The
+        # tunnel-crossing trio (e2e, serial, overlap) is repaired as ONE
+        # unit when either input leg collapsed: a ratio of an old-window
+        # e2e over a this-window serial was measured by no run and can
+        # even exceed the best-known speedup. (Model equality is
+        # guaranteed here: annotate_e2e resets history on a model switch,
+        # so a degraded flag implies same-model history.)
+        repaired = {
+            k: v for k, v in new_e2e.items()
+            if k not in ("degraded_vs_history", "degraded_legs")
+        }
+        legs = set(new_e2e.get("degraded_legs", ()))
+        if legs & {"e2e_img_s", "serial_img_s"}:
+            legs |= {"e2e_img_s", "serial_img_s"}
+            for k in ("e2e_img_s", "serial_img_s", "overlap_speedup"):
+                if old_e2e.get(k) is not None:
+                    repaired[k] = old_e2e[k]
+        for leg in legs - {"e2e_img_s", "serial_img_s"}:
+            if old_e2e.get(leg) is not None:
+                repaired[leg] = old_e2e[leg]
+        repaired["repaired_legs"] = sorted(legs)
+        repaired["stale"] = True
+        new_e2e = repaired
     if new_e2e and old_e2e and new_e2e.get("model") != old_e2e.get("model"):
         if any(v is None for v in new_e2e.values()):
             new_e2e = None  # partial for a different model: keep old whole
         else:
             old_e2e = None  # complete new section replaces old outright
     if new_e2e and old_e2e:
-        merged = {k: v for k, v in old_e2e.items() if k != "stale"}
+        # Strip the previous run's freshness bookkeeping: a healthy fresh
+        # section must not inherit a stale marker OR a repaired_legs label
+        # describing a splice that happened in some earlier run.
+        merged = {
+            k: v for k, v in old_e2e.items() if k not in ("stale", "repaired_legs")
+        }
         fell_back = False
         for k, v in new_e2e.items():
             if v is None and merged.get(k) is not None:
@@ -596,7 +630,8 @@ def bench_flash(deadline: float | None = None) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from dmlc_tpu.ops.pallas_kernels import flash_attention
+    from dmlc_tpu.ops import pallas_kernels as pk
+    from dmlc_tpu.ops.pallas_kernels import attention, flash_attention
     from dmlc_tpu.parallel.ring_attention import dense_attention
 
     def time_left() -> float:
@@ -623,11 +658,30 @@ def bench_flash(deadline: float | None = None) -> dict:
         np.asarray(q[0, 0, 0, :2])
         f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
         d = jax.jit(lambda q, k, v: dense_attention(q, k, v, causal=True))
+        a = jax.jit(lambda q, k, v: attention(q, k, v, causal=True))
         tf, td = timed(f, (q, k, v)), timed(d, (q, k, v))
+        # The dispatched entry point (VERDICT r4 item 3): auto must track
+        # best(flash, dense) at BOTH regimes — it picks dense here at
+        # S=2048 (small bh, score matrix under the cap) and flash at
+        # S=8192. Same-window timings, so the comparison is weather-fair.
+        ta = timed(a, (q, k, v))
         out[f"s{s}_h{h}"] = {
             "flash_ms": round(tf, 2),
             "dense_ms": round(td, 2),
+            "auto_ms": round(ta, 2),
+            "auto_picked": "dense" if pk.auto_picks_dense(1, h, s) else "flash",
             "dense_over_flash": round(td / tf, 3),
+        }
+    if out:
+        # The dispatch calibration, recorded next to the evidence.
+        out["dispatch"] = {
+            "auto_flash_min_s": pk.AUTO_FLASH_MIN_S,
+            "auto_dense_scores_cap_bytes": pk.AUTO_DENSE_SCORES_CAP_BYTES,
+            "note": (
+                "attention() picks dense below BOTH bounds, flash "
+                "otherwise; large-batch*heads crossover measured in "
+                "roofline_notes.lm_flash_train"
+            ),
         }
 
     # Composed ring+flash path (VERDICT r4 item 5). Two artifacts:
@@ -778,9 +832,15 @@ def bench_train(deadline: float | None = None) -> dict:
     if time_left() <= 0:
         return out
     Bl, S = 8, 2048
+    # heads=6 -> head_dim=128 == the MXU lane width. This is the TPU-first
+    # head geometry, not a benchmark trick: with the SAME params and
+    # flops, hd=64 (12 heads) measured the flash kernel 2.6x slower and
+    # the whole step at MFU 0.29 vs 0.43 — see
+    # ROOFLINE_NOTES["lm_flash_train"].
+    lm_heads, lm_hidden = 6, 768
     lm = SPTransformerLM(
-        vocab=32768, num_layers=8, num_heads=12, hidden=768, mlp_dim=3072,
-        max_len=S, schedule="flash", dtype=jnp.bfloat16,
+        vocab=32768, num_layers=8, num_heads=lm_heads, hidden=lm_hidden,
+        mlp_dim=3072, max_len=S, schedule="flash", dtype=jnp.bfloat16,
     )
     # S+1 raw tokens: the shifted input/target slices are then exactly S
     # long (an odd length like 2047 has no Mosaic-legal flash block and
@@ -822,6 +882,8 @@ def bench_train(deadline: float | None = None) -> dict:
     out["lm_flash_train"] = {
         "batch": Bl,
         "seq": S,
+        "heads": lm_heads,
+        "head_dim": lm_hidden // lm_heads,
         "chips": n_chips,
         "params_m": round(n_params / 1e6, 1),
         "tokens_per_sec": round(tok_s, 0),
@@ -856,6 +918,34 @@ ROOFLINE_NOTES = {
     "clip_vit_l14": (
         "Same attention geometry (hd=64) but D=1024/mlp 4096 raise the "
         "GEMM fraction: MFU ~0.47-0.50 measured. Batch 512 flat vs 256."
+    ),
+    "host_decode": (
+        "This host has ONE CPU core (nproc=1), so the decode thread pool "
+        "cannot scale and the per-core rate IS the host roofline: "
+        "libjpeg-turbo 2.1.5 (SIMD) measures ~0.4-0.7 ms/img pure decode "
+        "at 256px (the e2e decode_raw 2.2-2.5k img/s ceiling). Round 5 "
+        "tripled the 224-target path (482 -> ~1,450 img/s single-core) by "
+        "switching DCT-domain scaling from {1/2,1/4,1/8} to M/8 "
+        "granularity: a 256->224 request now decodes at 7/8 scale and "
+        "lands exactly on target, deleting the host-side triangle "
+        "resample that was 2/3 of per-image cost. Parity held (photo "
+        "fixture mean |diff| 0.31/255 vs PIL, all decode gates green). "
+        "The VERDICT r4 target of 5k img/s decode_raw needs >= 2-4 cores "
+        "at this per-core rate; the pipeline is thread-pooled and "
+        "TSan-clean, so it scales with cores on a real TPU-VM host."
+    ),
+    "lm_flash_train": (
+        "Head dim MUST be 128 (the MXU lane width) on this chip: at "
+        "hidden=768/S=2048/B=8 the flash kernel with hd=64 (12 heads) "
+        "measured 2.6x slower than hd=128 (6 heads) on identical flops, "
+        "and the full train step read MFU 0.286 vs 0.431 (88.5k vs 130.1k "
+        "tok/s) — the round-4 'training MFU 0.29' was the hd=64 geometry, "
+        "not the flash backward. Dense-schedule A/B at the same shapes: "
+        "hd=64 step 286 ms, hd=128 step 159 ms — both slower than flash "
+        "(190/126 ms), so the kernel choice was already right. mfu_6nd "
+        "still UNDERcounts utilization here: 6ND counts the 25M-param "
+        "embedding lookup as matmul flops and excludes ~20% real "
+        "attention flops (S=2048)."
     ),
 }
 

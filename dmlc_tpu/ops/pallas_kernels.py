@@ -388,6 +388,13 @@ def flash_attention(q, k, v, *, causal: bool = False, scale: float | None = None
     parity at S=2048, 1.1-1.5x faster at S=8192 (artifact:
     bench_detail.json["flash"], re-measured every bench run).
 
+    Design your models with Dh = 128 — the MXU lane width. The kernel
+    accepts any Dh, but Dh=64 measured 2.6x slower than Dh=128 on
+    identical flops (B=8, S=2048; half of every 128-lane tile idle), and
+    an 8-layer LM's whole train step went from MFU 0.29 to 0.43 by
+    switching 12 heads of 64 to 6 heads of 128
+    (bench_detail.json["roofline_notes"]["lm_flash_train"]).
+
     Two forward schedules, chosen by K/V footprint (_RESIDENT_KV_BYTES):
     VMEM-resident K/V while it fits (K/V read from HBM once per batch-head),
     HBM-streamed K/V blocks past that (unbounded S — the old hard S=8192
@@ -595,3 +602,44 @@ def softmax_top1(logits):
         interpret=_interpret(),
     )(logits)
     return idx[:, 0], prob[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# Crossover-dispatched attention
+# ---------------------------------------------------------------------------
+
+# Calibration, measured on this repo's v5e (bf16, causal, Dh=128; the
+# artifact re-measures every bench run — bench_detail.json["flash"], the
+# "dispatch" entry records these constants next to the timings):
+# - Small problems: XLA dense wins (best-of-history 4.67 ms vs flash 5.21
+#   at S=2048, bh=8) — the score matrix fits comfortably and XLA's fused
+#   softmax beats the kernel's block bookkeeping.
+# - Long sequences: flash wins (6.43 vs 7.18 ms at S=8192) and is the only
+#   path that scales past HBM (O(S) memory).
+# - Large batch*heads at moderate S: flash wins even at S=2048 — an
+#   8-layer LM at bh=48 measured flash step 126 ms vs dense 159, because
+#   dense's f32 score matrix (bh * S^2 * 4 bytes = 805 MB there) turns the
+#   whole layer HBM-bound. Hence the second bound below.
+AUTO_FLASH_MIN_S = 4096
+AUTO_DENSE_SCORES_CAP_BYTES = 256 * 1024 * 1024
+
+
+def auto_picks_dense(b: int, h: int, s: int) -> bool:
+    """The dispatch predicate, exposed so artifacts/telemetry that record
+    which leg ``attention`` ran share ONE definition with the dispatch."""
+    return s < AUTO_FLASH_MIN_S and 4 * b * h * s * s <= AUTO_DENSE_SCORES_CAP_BYTES
+
+
+def attention(q, k, v, *, causal: bool = False, scale: float | None = None):
+    """Attention with measured crossover dispatch: XLA dense when the
+    problem is small enough for dense to win (S below AUTO_FLASH_MIN_S AND
+    the f32 score matrix under AUTO_DENSE_SCORES_CAP_BYTES), the blockwise
+    flash kernel otherwise. Shapes [B, H, S, Dh]; prefer Dh=128 (see
+    flash_attention). The dispatch is static per compiled shape — no
+    data-dependent control flow under jit."""
+    b, h, s, _ = q.shape
+    if auto_picks_dense(b, h, s):
+        from dmlc_tpu.parallel.ring_attention import dense_attention
+
+        return dense_attention(q, k, v, causal=causal, scale=scale)
+    return flash_attention(q, k, v, causal=causal, scale=scale)

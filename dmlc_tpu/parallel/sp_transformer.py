@@ -29,7 +29,7 @@ from dmlc_tpu.parallel.ring_attention import (
 )
 from dmlc_tpu.parallel.ulysses import ulysses_attention
 
-_SCHEDULES = ("ring", "ring_flash", "ulysses", "dense", "flash")
+_SCHEDULES = ("ring", "ring_flash", "ulysses", "dense", "flash", "auto")
 
 
 class SPSelfAttention(nn.Module):
@@ -42,7 +42,10 @@ class SPSelfAttention(nn.Module):
     head/sequence reshard, needs heads % sp == 0), "dense" (no sp —
     single-device reference semantics, used for parity tests), or "flash"
     (no sp — the blockwise Pallas kernel, ops/pallas_kernels.py: O(S)
-    memory and faster than dense on TPU for the single-device regime)."""
+    memory and faster than dense on TPU for the single-device regime), or
+    "auto" (no sp — measured crossover dispatch between dense and flash by
+    sequence length and score-matrix footprint, ops/pallas_kernels.py:
+    attention; the right default when not sequence-sharding)."""
 
     num_heads: int
     mesh: Mesh | None = None
@@ -74,6 +77,10 @@ class SPSelfAttention(nn.Module):
             from dmlc_tpu.ops.pallas_kernels import flash_attention
 
             o = flash_attention(q, k, v, causal=self.causal)
+        elif self.schedule == "auto":
+            from dmlc_tpu.ops.pallas_kernels import attention
+
+            o = attention(q, k, v, causal=self.causal)
         else:
             o = dense_attention(q, k, v, causal=self.causal)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, d)

@@ -401,11 +401,56 @@ class TestE2eGuard:
         new = bench.annotate_e2e({"model": "resnet18", "e2e_img_s": 46.3,
                                   "serial_img_s": 58.0}, self.OLD)
         assert new["degraded_vs_history"] is True
+        assert new["degraded_legs"] == ["e2e_img_s"]  # serial 58 > 82/2
         assert new["best_e2e_img_s"] == 113.2  # the record never degrades
         merged = bench.merge_detail({"configs": [], "e2e": new},
                                     {"configs": [], "e2e": self.OLD})
         assert merged["e2e"]["e2e_img_s"] == 113.2
         assert merged["e2e"]["stale"] is True
+        # The tunnel trio is repaired as one unit (no cross-window ratios).
+        assert merged["e2e"]["repaired_legs"] == ["e2e_img_s", "serial_img_s"]
+
+    def test_per_leg_repair_keeps_healthy_host_legs(self):
+        # Round 5: the tunnel legs collapsed in the SAME window that
+        # captured a 3x host-decode improvement — the repair must keep the
+        # fresh decode legs, splice the old tunnel legs, and recompute the
+        # derived overlap ratio from the repaired inputs.
+        new = bench.annotate_e2e(
+            {"model": "resnet18", "e2e_img_s": 56.3, "serial_img_s": 69.5,
+             "decode_only_img_s": 1377.5, "decode_raw_img_s": 2357.6,
+             "overlap_speedup": 0.81},
+            self.OLD,
+        )
+        assert set(new["degraded_legs"]) == {"e2e_img_s"}
+        merged = bench.merge_detail({"configs": [], "e2e": new},
+                                    {"configs": [], "e2e": self.OLD})
+        e = merged["e2e"]
+        assert e["decode_only_img_s"] == 1377.5  # healthy improvement kept
+        # The tunnel-crossing trio is repaired as ONE unit: an old-window
+        # e2e over a this-window serial is a ratio no run measured (and
+        # 113.2/69.5 = 1.63 would exceed the best-known 1.37).
+        assert e["e2e_img_s"] == 113.2
+        assert e["serial_img_s"] == 82.0
+        assert e["overlap_speedup"] == 1.37
+        assert e["stale"] is True
+        assert e["repaired_legs"] == ["e2e_img_s", "serial_img_s"]
+        assert e["best_decode_only_img_s"] == 1377.5
+
+    def test_repaired_label_does_not_leak_into_healthy_run(self):
+        # A later fully-healthy run must not inherit the repaired_legs
+        # label (or stale) from the previously committed repaired section.
+        prev = dict(self.OLD, repaired_legs=["e2e_img_s", "serial_img_s"], stale=True)
+        fresh = bench.annotate_e2e(
+            {"model": "resnet18", "e2e_img_s": 140.0, "serial_img_s": 120.0,
+             "decode_only_img_s": 1400.0, "overlap_speedup": 1.17},
+            prev,
+        )
+        assert "degraded_vs_history" not in fresh
+        merged = bench.merge_detail({"configs": [], "e2e": fresh},
+                                    {"configs": [], "e2e": prev})
+        assert "repaired_legs" not in merged["e2e"]
+        assert "stale" not in merged["e2e"]
+        assert merged["e2e"]["e2e_img_s"] == 140.0
 
     def test_no_history_never_flags(self):
         out = bench.annotate_e2e({"model": "resnet18", "e2e_img_s": 46.3}, None)

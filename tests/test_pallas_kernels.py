@@ -207,3 +207,56 @@ def test_auto_block_explicit_oversized_request_falls_back_to_divisors():
 
     with pytest.raises(ValueError, match="pad the sequence"):
         _auto_block(_FULL_BLOCK_CAP * 2 + 1, None, 128)  # odd, > cap
+
+
+class TestAttentionDispatch:
+    """Crossover-dispatched attention() (VERDICT r4 item 3): dense below
+    both calibrated bounds, flash otherwise; numerically it must agree
+    with both legs everywhere."""
+
+    def _routed(self, monkeypatch, b, h, s):
+        import importlib
+
+        # The MODULE by dotted path: the package __init__ re-exports a
+        # same-named FUNCTION that shadows the submodule under normal
+        # attribute-style imports.
+        ra = importlib.import_module("dmlc_tpu.parallel.ring_attention")
+        from dmlc_tpu.ops import pallas_kernels as pk
+
+        calls = []
+        monkeypatch.setattr(
+            pk, "flash_attention",
+            lambda q, k, v, **kw: (calls.append("flash"), q)[1],
+        )
+        monkeypatch.setattr(
+            ra, "dense_attention",
+            lambda q, k, v, **kw: (calls.append("dense"), q)[1],
+        )
+        q = jnp.zeros((b, h, s, 128), jnp.bfloat16)
+        pk.attention(q, q, q)
+        return calls[-1]
+
+    def test_small_problem_routes_dense(self, monkeypatch):
+        assert self._routed(monkeypatch, 1, 8, 2048) == "dense"
+
+    def test_long_sequence_routes_flash(self, monkeypatch):
+        from dmlc_tpu.ops import pallas_kernels as pk
+
+        assert 8192 >= pk.AUTO_FLASH_MIN_S
+        assert self._routed(monkeypatch, 1, 2, 8192) == "flash"
+
+    def test_large_batch_heads_routes_flash_below_threshold(self, monkeypatch):
+        # The LM regime: S=2048 but bh=48 -> 805 MB f32 scores > cap.
+        assert self._routed(monkeypatch, 8, 6, 2048) == "flash"
+
+    def test_dispatch_agrees_with_both_legs(self):
+        from dmlc_tpu.ops import pallas_kernels as pk
+        from dmlc_tpu.parallel.ring_attention import dense_attention
+
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(k1, (2, 2, 128, 128), jnp.float32)
+        k = jax.random.normal(k2, (2, 2, 128, 128), jnp.float32)
+        v = jax.random.normal(k3, (2, 2, 128, 128), jnp.float32)
+        want = dense_attention(q, k, v, causal=True)
+        got = pk.attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)

@@ -44,9 +44,11 @@ def test_schedules_agree_inside_the_model(setup):
         model = build(mesh, schedule)
         got = np.asarray(jax.jit(model.apply)(variables, tokens_sharded))
         np.testing.assert_allclose(got, want, atol=3e-5, rtol=1e-4)
-    # The single-device Pallas flash schedule agrees too (same params).
-    got = np.asarray(jax.jit(build(None, "flash").apply)(variables, tokens))
-    np.testing.assert_allclose(got, want, atol=3e-5, rtol=1e-4)
+    # The single-device Pallas flash schedule agrees too (same params),
+    # and so does the crossover-dispatched "auto" schedule.
+    for schedule in ("flash", "auto"):
+        got = np.asarray(jax.jit(build(None, schedule).apply)(variables, tokens))
+        np.testing.assert_allclose(got, want, atol=3e-5, rtol=1e-4)
 
 
 def test_causal(setup):
