@@ -21,8 +21,15 @@ file, to be `put` later from any node's CLI.
 
 from __future__ import annotations
 
-import argparse
+import os
 import sys
+
+# Standalone invocation (`python tools/<name>.py`) puts tools/ on
+# sys.path, not the repo root — self-path so the documented command
+# works without PYTHONPATH.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
 from pathlib import Path
 
 
