@@ -1049,6 +1049,16 @@ def main() -> None:
         help="force ONE batch size for every config (default: 256, with the "
         "headline ResNet-18 auto-tuned to 1024)",
     )
+    parser.add_argument(
+        "--extra-models",
+        # alexnet: the reference's SECOND live job (services.rs:146-151),
+        # so the artifact carries a measured number for it — benched LAST,
+        # after every primary section, so it can consume budget only the
+        # primaries left over (a fifth secondary in the main loop could
+        # starve e2e/flash/curve/train of --budget-s).
+        default="alexnet",
+        help="models benched after all primary sections, budget-gated",
+    )
     parser.add_argument("--e2e", action="store_true", default=True)
     parser.add_argument("--no-e2e", dest="e2e", action="store_false")
     parser.add_argument("--corpus", default="bench_corpus")
@@ -1102,7 +1112,9 @@ def main() -> None:
         parser.error("--batch-size must be positive")
     base_batch = args.batch_size if args.batch_size is not None else 256
     batch_overrides = (
-        {"resnet18": 1024, "resnet50": 512} if args.batch_size is None else {}
+        {"resnet18": 1024, "resnet50": 512, "alexnet": 1024}
+        if args.batch_size is None
+        else {}
     )
     models = [m.strip() for m in args.models.split(",") if m.strip()]
 
@@ -1342,6 +1354,27 @@ def main() -> None:
                 )
         except Exception as e:
             print(f"[bench-train] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+
+    # Extra models: measured numbers for the remaining reference configs,
+    # strictly after every primary section has had its shot at the budget.
+    for model in [m.strip() for m in args.extra_models.split(",") if m.strip()]:
+        if model in models or over_budget(f"extra {model}"):
+            continue
+        try:
+            r = bench_model(
+                model,
+                batch_overrides.get(model, base_batch),
+                seconds=3.0,
+                passes=2,
+                deadline=time.monotonic() + CAPS["secondary"],
+            )
+        except Exception as e:
+            print(f"[bench] {model} FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+            continue
+        if degraded_vs_best(r, history_best):
+            r["degraded_vs_history"] = True
+        results.append(r)
+        stderr_line(r)
 
     annotate_config_tails(results, history_best)
     for r in results:

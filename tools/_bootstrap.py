@@ -1,0 +1,15 @@
+"""Shared standalone-invocation bootstrap for the tools/ scripts.
+
+``python tools/<name>.py`` puts tools/ on sys.path, not the repo root, so
+the documented commands would fail to import ``dmlc_tpu`` without
+PYTHONPATH. Each script does ``import _bootstrap`` (resolvable precisely
+because tools/ IS on sys.path then) and this module self-paths the repo
+root once.
+"""
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)

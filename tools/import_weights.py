@@ -21,15 +21,13 @@ file, to be `put` later from any node's CLI.
 
 from __future__ import annotations
 
-import os
-import sys
-
-# Standalone invocation (`python tools/<name>.py`) puts tools/ on
-# sys.path, not the repo root — self-path so the documented command
-# works without PYTHONPATH.
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+try:
+    import _bootstrap  # noqa: F401  (repo-root sys.path for standalone runs)
+except ImportError:  # loaded by path (tests) — caller already arranged sys.path
+    pass
 
 import argparse
+import sys
 from pathlib import Path
 
 

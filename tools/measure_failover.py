@@ -31,7 +31,10 @@ import tempfile
 import time
 from pathlib import Path
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+try:
+    import _bootstrap  # noqa: F401  (repo-root sys.path for standalone runs)
+except ImportError:  # loaded by path (tests) — caller already arranged sys.path
+    pass
 
 from dmlc_tpu.cluster.localcluster import (
     make_synsets,
