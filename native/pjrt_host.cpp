@@ -921,6 +921,13 @@ int Serve(int argc, char** argv) {
     return 1;
   }
   const int64_t B = manifest.batch, S = manifest.size;
+  if (B <= 0 || S <= 0) {
+    // A zero batch would make every chunk loop below spin forever.
+    std::fprintf(stderr,
+                 "pjrt_host: degenerate image geometry batch=%lld size=%lld\n",
+                 static_cast<long long>(B), static_cast<long long>(S));
+    return 1;
+  }
 
   Host host;
   if (Boot(so_path, options_path, bundle, &host)) return 1;
@@ -936,24 +943,59 @@ int Serve(int argc, char** argv) {
                static_cast<long long>(B), static_cast<long long>(S));
 
   std::vector<uint8_t> pixels(B * S * S * 3);
-  auto classify_paths = [&](const std::vector<std::string>& paths) -> int {
+  // The ONE chunk iterator every phase uses: batch-sized sub-lists of
+  // `paths`, the callback returning nonzero to abort.
+  auto for_each_chunk = [B](const std::vector<std::string>& paths,
+                            auto fn) -> int {
+    for (size_t s = 0; s < paths.size(); s += B) {
+      std::vector<std::string> chunk(
+          paths.begin() + s,
+          paths.begin() + std::min(paths.size(), s + static_cast<size_t>(B)));
+      if (int rc = fn(chunk)) return rc;
+    }
+    return 0;
+  };
+  // Classify one <=B chunk against the resident weights, APPENDING the
+  // per-real-slot results — callers aggregate chunks into one reply.
+  auto classify_chunk = [&](const std::vector<std::string>& chunk,
+                            std::vector<int32_t>* top1, std::vector<float>* prob,
+                            std::vector<bool>* failed) -> int {
     std::vector<bool> decode_failed;
-    int failures = DecodePadded(paths, B, S, pixels.data(), threads, &decode_failed);
+    int failures = DecodePadded(chunk, B, S, pixels.data(), threads, &decode_failed);
     if (failures)
       std::fprintf(stderr, "pjrt_host: %d decode failure(s) in batch\n", failures);
     PJRT_Buffer* image = StageBuffer(host, manifest.args[manifest.image_arg],
                                      pixels.data());
     if (!image) return 1;
-    std::vector<int32_t> top1;
-    std::vector<float> prob;
-    int rc = ClassifyStaged(host, manifest, args, image, &top1, &prob);
+    std::vector<int32_t> t;
+    std::vector<float> p;
+    int rc = ClassifyStaged(host, manifest, args, image, &t, &p);
     DestroyBuffer(image);
     if (rc) return rc;
-    PrintBatchResult(paths, top1, prob, decode_failed);
+    for (size_t i = 0; i < chunk.size(); ++i) {
+      top1->push_back(i < t.size() ? t[i] : -1);
+      prob->push_back(i < p.size() ? p[i] : 0.0f);
+      failed->push_back(decode_failed[i]);
+    }
+    return 0;
+  };
+  // One request (any size) -> ONE JSON reply line, chunked internally:
+  // stdin clients frame responses by line, so a 130-image request against
+  // a batch-64 bundle must not answer as three lines.
+  auto classify_request = [&](const std::vector<std::string>& paths) -> int {
+    std::vector<int32_t> top1;
+    std::vector<float> prob;
+    std::vector<bool> failed;
+    int rc = for_each_chunk(paths, [&](const std::vector<std::string>& chunk) {
+      return classify_chunk(chunk, &top1, &prob, &failed);
+    });
+    if (rc) return rc;
+    PrintBatchResult(paths, top1, prob, failed);
     return 0;
   };
 
-  // Phase 1: classify the directory, batch by batch.
+  // Phase 1: classify the directory, one reply line per batch (streaming —
+  // a large directory should not buffer its whole answer).
   std::vector<std::string> files;
   if (dir) {
     files = ListJpegs(dir);
@@ -961,12 +1003,10 @@ int Serve(int argc, char** argv) {
       std::fprintf(stderr, "pjrt_host: no JPEGs in %s\n", dir);
       return 1;
     }
-    for (size_t s = 0; s < files.size(); s += B) {
-      std::vector<std::string> chunk(
-          files.begin() + s,
-          files.begin() + std::min(files.size(), s + static_cast<size_t>(B)));
-      if (classify_paths(chunk)) return 1;
-    }
+    if (for_each_chunk(files, [&](const std::vector<std::string>& chunk) {
+          return classify_request(chunk);
+        }))
+      return 1;
   }
 
   // Phase 2: sustained-throughput passes, decode pipelined against device
@@ -995,10 +1035,7 @@ int Serve(int argc, char** argv) {
     struct timespec t0, t1;
     clock_gettime(CLOCK_MONOTONIC, &t0);
     for (int pass = 0; pass < repeat; ++pass) {
-      for (size_t s = 0; s < files.size(); s += B) {
-        std::vector<std::string> chunk(
-            files.begin() + s,
-            files.begin() + std::min(files.size(), s + static_cast<size_t>(B)));
+      int rc = for_each_chunk(files, [&](const std::vector<std::string>& chunk) {
         // Decode on the host WHILE the previously dispatched batches run.
         decode_failures += DecodePadded(chunk, B, S, pixels.data(), threads);
         PJRT_Buffer* image =
@@ -1020,7 +1057,9 @@ int Serve(int argc, char** argv) {
         pending_events.push_back(ev);
         images += chunk.size();
         if (pending_events.size() >= depth && await_oldest()) return 1;
-      }
+        return 0;
+      });
+      if (rc) return 1;
     }
     // Drain all but the last; read the last batch's top-1 back as the
     // barrier that proves the work actually finished on-device.
@@ -1050,8 +1089,10 @@ int Serve(int argc, char** argv) {
   }
 
   // Phase 3: the long-lived request loop. One line = one predict request
-  // (whitespace-separated JPEG paths, up to the export batch); EOF ends
-  // the process. This is the reference's `predict` service surface
+  // (whitespace-separated JPEG paths — ANY count; oversized requests are
+  // chunked internally but always answered as ONE JSON line, preserving
+  // the line-framed request/response contract); EOF ends the process.
+  // This is the reference's `predict` service surface
   // (services.rs:475-497) with the model resident from boot.
   char line[65536];
   while (std::fgets(line, sizeof(line), stdin)) {
@@ -1060,15 +1101,9 @@ int Serve(int argc, char** argv) {
          tok = std::strtok(nullptr, " \t\r\n"))
       paths.push_back(tok);
     if (paths.empty()) continue;
-    if (static_cast<int64_t>(paths.size()) > B) {
-      std::printf("{\"error\": \"request of %zu images exceeds batch %lld\"}\n",
-                  paths.size(), static_cast<long long>(B));
-      std::fflush(stdout);
-      continue;
-    }
-    if (classify_paths(paths)) {
-      // A failed execute is fatal (client state unknown); a decode failure
-      // was already reported per-slot and the batch still answered.
+    if (classify_request(paths)) {
+      // A failed execute is fatal (client state unknown); a decode
+      // failure was already reported per-slot and the request answered.
       return 1;
     }
   }
