@@ -59,6 +59,7 @@ import os
 import struct
 import threading
 import time
+from typing import Callable
 
 
 TAG_BYTES = 16
@@ -94,9 +95,16 @@ class FrameAuth:
         sender: str | None = None,
         window_s: float = 60.0,
         max_age_s: float = 120.0,
+        now_ns: Callable[[], int] | None = None,
     ):
         if not key:
             raise ValueError("FrameAuth requires a non-empty key")
+        # Injectable nanosecond clock (sans-IO discipline, cluster/clock.py):
+        # sequence numbers and the unknown-sender freshness bound both read
+        # it, so tests can drive replay-window scenarios deterministically.
+        # The default IS wall time — the replay protocol's freshness bound
+        # is anchored to real clocks across the fleet by design.
+        self._now_ns = now_ns or time.time_ns
         self._key = key.encode() if isinstance(key, str) else bytes(key)
         sid = (sender or os.urandom(8).hex()).encode()
         if len(sid) > 255:
@@ -132,7 +140,7 @@ class FrameAuth:
         if not rid or len(rid) > 255:
             raise ValueError("recipient must be 1..255 bytes")
         with self._lock:
-            seq = max(self._last_seq + 1, time.time_ns())
+            seq = max(self._last_seq + 1, self._now_ns())
             self._last_seq = seq
         body = (
             _HDR.pack(_VERSION, seq, len(self._sender), len(rid))
@@ -173,7 +181,7 @@ class FrameAuth:
         with self._lock:
             state = self._peers.get(sender)
             if state is None:
-                if abs(seq - time.time_ns()) > self._max_age_ns:
+                if abs(seq - self._now_ns()) > self._max_age_ns:
                     raise AuthError("stale frame from unknown sender")
                 if len(self._peers) >= _MAX_SENDERS:
                     # Evict the peer with the oldest highest-seen sequence:

@@ -27,7 +27,6 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from pathlib import Path
 
 from dmlc_tpu.cluster.clock import Clock
@@ -75,7 +74,7 @@ class ClusterNode:
                 if bound is not None:
                     try:
                         bound.close()
-                    except Exception:
+                    except Exception:  # dmlc-lint: disable=E1 -- best-effort close mid-unwind; the original error re-raises below
                         pass
             raise
 
@@ -254,9 +253,7 @@ class ClusterNode:
     def _member_weight(self, addr: str) -> int:
         """TTL-cached node.info lookup used by the scheduler's assignment
         pass; unreachable members keep their last known (or unit) weight."""
-        import time as _time
-
-        now = _time.monotonic()
+        now = self.clock.monotonic()
         cached = self._weight_cache.get(addr)
         if cached is not None and now - cached[1] < 30.0:
             return cached[0]

@@ -30,6 +30,18 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct inheriting ``like``'s varying-manual-axes set on
+    jax versions that track one (jax.typeof, >= 0.7); the plain struct on
+    older jax, whose ShapeDtypeStruct has no vma parameter."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(
+        shape, dtype, vma=getattr(typeof(like), "vma", frozenset())
+    )
+
+
 # ---------------------------------------------------------------------------
 # uint8 -> normalized float (NHWC)
 # ---------------------------------------------------------------------------
@@ -474,13 +486,12 @@ def _flash_forward(causal, scale, blk_q, blk_k, q, k, v):
     q3, k3, v3 = (x.reshape(b * h, s, dh) for x in (q, k, v))
     # Under shard_map (e.g. as Ulysses' per-device attention) the output
     # must declare which mesh axes it varies over — inherit q's.
-    vma = getattr(jax.typeof(q3), "vma", frozenset())
     # lse rides as [bh, S, 1]: the trailing singleton keeps the Mosaic
     # block-shape rule happy ((1, blk_q, 1) has its last dim equal to the
     # array's) AND gives kernels the [blk_q, 1] column layout directly.
     out_shapes = (
-        jax.ShapeDtypeStruct((b * h, s, dh), q.dtype, vma=vma),
-        jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32, vma=vma),  # lse
+        _sds((b * h, s, dh), q.dtype, q3),
+        _sds((b * h, s, 1), jnp.float32, q3),  # lse
     )
     resident = 2 * s * dh * q.dtype.itemsize <= _RESIDENT_KV_BYTES
     if resident:
@@ -540,14 +551,13 @@ def _flash_backward(causal, scale, q, k, v, out, lse, do, delta=None):
     # so 256 blocks keep both kernels MXU-bound; shrink for short S.
     blk_q = _auto_block(s, None, 256)
     blk_k = _auto_block(s, None, 256)
-    vma = getattr(jax.typeof(q3), "vma", frozenset())
 
     qspec = pl.BlockSpec((1, blk_q, dh), lambda bh, iq, ik: (bh, iq, 0), memory_space=pltpu.VMEM)
     kspec = pl.BlockSpec((1, blk_k, dh), lambda bh, iq, ik: (bh, ik, 0), memory_space=pltpu.VMEM)
     rowspec = pl.BlockSpec((1, blk_q, 1), lambda bh, iq, ik: (bh, iq, 0), memory_space=pltpu.VMEM)
     dq = pl.pallas_call(
         partial(_flash_bwd_dq_kernel, causal=causal, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((bh, s, dh), q.dtype, vma=vma),
+        out_shape=_sds((bh, s, dh), q.dtype, q3),
         grid=(bh, s // blk_q, s // blk_k),
         in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
         out_specs=qspec,
@@ -563,8 +573,8 @@ def _flash_backward(causal, scale, q, k, v, out, lse, do, delta=None):
     dk, dv = pl.pallas_call(
         partial(_flash_bwd_dkv_kernel, causal=causal, scale=scale),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, s, dh), k.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh, s, dh), v.dtype, vma=vma),
+            _sds((bh, s, dh), k.dtype, q3),
+            _sds((bh, s, dh), v.dtype, q3),
         ),
         grid=(bh, s // blk_k, s // blk_q),
         in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],

@@ -1,0 +1,35 @@
+"""JAX version compatibility shims.
+
+``jax.shard_map`` graduated out of ``jax.experimental`` only after the
+jax this image ships (0.4.37); every sp/pp schedule routes through this
+one alias so the code runs on both sides of the move.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # jax < 0.5: experimental home, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, **kwargs):  # type: ignore[no-redef]
+        # Callers use the current ``check_vma`` spelling; the experimental
+        # API called the same knob ``check_rep``.
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _experimental_shard_map(f, **kwargs)
+
+
+def axis_size(axis_name: str) -> int:
+    """Static size of a named mesh axis inside a shard_map body.
+
+    ``lax.axis_size`` postdates this image's jax; ``psum(1, axis)`` is the
+    classic spelling and constant-folds to a concrete int during the
+    shard_map trace, so ring perms / scan lengths built from it stay static.
+    """
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        return jax.lax.psum(1, axis_name)

@@ -36,6 +36,8 @@ import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dmlc_tpu.parallel.compat import shard_map
+
 from dmlc_tpu.parallel.ring_attention import dense_attention
 
 
@@ -101,6 +103,6 @@ def ulysses_attention(
     # suggests exactly this workaround). Compiled TPU runs and the dense
     # variant keep full checking.
     check = not (use_flash and jax.default_backend() != "tpu")
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=check
     )(q, k, v)

@@ -67,6 +67,7 @@ class SdfsImageSource:
         with self._lock:
             if local.exists():  # raced another shard for the same class
                 return local
+            # dmlc-lint: disable=L1 -- cache-fill lock: the pull IS the critical section (one network fetch per class image; racing shards for the same class must wait for the bytes, not re-pull)
             _, data = self.sdfs.get_bytes(sdfs_image_name(synset))
             tmp = local.with_suffix(".tmp")
             tmp.write_bytes(data)

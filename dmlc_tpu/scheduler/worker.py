@@ -339,6 +339,7 @@ class ExportedBackend:
             u8_aval = u8_avals[0]
             artifact_batch = int(u8_aval.shape[0])
             try:
+                # dmlc-lint: disable=L1 -- one-time lazy init: shards arriving before the artifact is resident MUST block here; after first load the fetch never runs again
                 _, blob = self.sdfs.get_bytes(weights_lib.sdfs_weights_name(self.model_name))
                 # Validation errors (corrupt/mismatched blob) PROPAGATE —
                 # weights.py's contract is fail-at-load, never serve them.
@@ -380,6 +381,7 @@ class ExportedBackend:
                 )
                 fut = decoder.submit(decode, starts[0])
                 for i, s in enumerate(starts):
+                    # dmlc-lint: disable=L1 -- the backend lock serializes shards per artifact by design (reference's model mutex); the wait is the decode/execute pipeline inside one shard
                     batch = fut.result()
                     if i + 1 < len(starts):
                         fut = decoder.submit(decode, starts[i + 1])

@@ -11,6 +11,7 @@ decode-from-disk path without shipping binary fixtures.
 
 from __future__ import annotations
 
+import shutil
 from pathlib import Path
 
 import numpy as np
@@ -52,6 +53,17 @@ def _reusable(
     return None
 
 
+def _fresh_tree(root: Path) -> None:
+    """Remove a non-reusable corpus before regenerating. The generators
+    write only the first n_classes dirs / images_per_class files; without
+    this wipe, leftover class dirs and higher-index images from a previous
+    different-kind (or bigger) corpus at the same root would survive under
+    the new ``.corpus_kind`` marker, and any consumer that globs class
+    dirs would see mixed-kind data."""
+    shutil.rmtree(root / "train", ignore_errors=True)
+    (root / ".corpus_kind").unlink(missing_ok=True)
+
+
 def generate_learnable(
     root: str | Path,
     n_classes: int = 40,
@@ -81,6 +93,7 @@ def generate_learnable(
     reuse = _reusable(root, n_classes, images_per_class, "learnable")
     if reuse is not None:
         return reuse
+    _fresh_tree(root)
 
     data_dir = root / "train"
     synset_path = write_synset_words(root / "synset_words.txt", n_classes)
@@ -124,6 +137,7 @@ def generate(
     reuse = _reusable(root, n_classes, images_per_class, "iid")
     if reuse is not None:
         return reuse
+    _fresh_tree(root)
 
     data_dir = root / "train"
     synset_path = write_synset_words(root / "synset_words.txt", n_classes)

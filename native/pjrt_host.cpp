@@ -343,6 +343,7 @@ PJRT_Error* DispatchExec(PJRT_LoadedExecutable* exec, PJRT_ExecuteOptions* eopts
 }
 
 void DestroyBuffer(PJRT_Buffer* b) {
+  if (!b) return;  // error paths destroy output vectors that never filled in
   PJRT_Buffer_Destroy_Args bd;
   std::memset(&bd, 0, sizeof(bd));
   bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
@@ -722,6 +723,48 @@ int Run(int argc, char** argv) {
 // serve / stage: the resident JPEG->top-1 loop and its hermetic half
 // ---------------------------------------------------------------------------
 
+// Read one stdin request line of ANY length: fgets chunks are appended
+// until the newline arrives, so a request longer than one buffer is never
+// silently split into several bogus requests (each with a truncated path
+// at the seam) answered by several reply lines. Returns false at EOF with
+// nothing pending; a final unterminated line still counts as one request.
+bool ReadRequestLine(std::string* line) {
+  line->clear();
+  char chunk[65536];
+  while (std::fgets(chunk, sizeof(chunk), stdin)) {
+    line->append(chunk);
+    if (!line->empty() && line->back() == '\n') return true;
+  }
+  return !line->empty();
+}
+
+std::vector<std::string> SplitWhitespace(const std::string& line) {
+  std::vector<std::string> out;
+  size_t b = 0;
+  while ((b = line.find_first_not_of(" \t\r\n", b)) != std::string::npos) {
+    size_t e = line.find_first_of(" \t\r\n", b);
+    if (e == std::string::npos) e = line.size();
+    out.push_back(line.substr(b, e - b));
+    b = e;
+  }
+  return out;
+}
+
+// Hermetic self-test of the request framing (no plugin, no TPU): echo one
+// JSON line per stdin request with its token count. A CPU-only test pipes
+// a request far longer than the fgets buffer through this and asserts ONE
+// reply — the line-framed request/response contract serve relies on.
+int FrameCheck() {
+  std::string line;
+  while (ReadRequestLine(&line)) {
+    std::vector<std::string> toks = SplitWhitespace(line);
+    if (toks.empty()) continue;
+    std::printf("{\"paths\": %zu, \"bytes\": %zu}\n", toks.size(), line.size());
+  }
+  std::fflush(stdout);
+  return 0;
+}
+
 bool HasJpegSuffix(const std::string& name) {
   auto dot = name.rfind('.');
   if (dot == std::string::npos) return false;
@@ -785,15 +828,23 @@ int ClassifyStaged(const Host& h, const Manifest& m,
   PJRT_Buffer* const* arg_lists[1] = {args.data()};
   std::vector<PJRT_Buffer*> outs(h.num_outputs, nullptr);
   PJRT_Event* ev = nullptr;
+  // Every early return must destroy whatever outs filled in (AwaitEvent and
+  // ReadbackBuffer already destroy their events): serve treats these
+  // failures as fatal today, but a caller that keeps going must not leak a
+  // batch of output buffers per failed execute.
+  auto fail = [&outs]() {
+    DestroyBuffers(outs);
+    return 1;
+  };
   PJRT_Error* err = DispatchExec(h.exec, &eopts, arg_lists, args.size(), &outs, &ev);
   if (err) {
     std::fprintf(stderr, "pjrt_host: execute failed: %s\n", ErrMessage(err).c_str());
-    return 1;
+    return fail();
   }
-  if (AwaitEvent(ev)) return 1;
+  if (AwaitEvent(ev)) return fail();
   std::vector<char> idx_bytes, prob_bytes;
-  if (ReadbackBuffer(outs[0], &idx_bytes)) return 1;
-  if (outs.size() > 1 && ReadbackBuffer(outs[1], &prob_bytes)) return 1;
+  if (ReadbackBuffer(outs[0], &idx_bytes)) return fail();
+  if (outs.size() > 1 && ReadbackBuffer(outs[1], &prob_bytes)) return fail();
   DestroyBuffers(outs);
   top1->assign(reinterpret_cast<const int32_t*>(idx_bytes.data()),
                reinterpret_cast<const int32_t*>(idx_bytes.data() + idx_bytes.size()));
@@ -1094,12 +1145,11 @@ int Serve(int argc, char** argv) {
   // the line-framed request/response contract); EOF ends the process.
   // This is the reference's `predict` service surface
   // (services.rs:475-497) with the model resident from boot.
-  char line[65536];
-  while (std::fgets(line, sizeof(line), stdin)) {
-    std::vector<std::string> paths;
-    for (char* tok = std::strtok(line, " \t\r\n"); tok;
-         tok = std::strtok(nullptr, " \t\r\n"))
-      paths.push_back(tok);
+  // One physical line = one request, at ANY length (ReadRequestLine
+  // accumulates past the fgets buffer; frame-check pins this hermetically).
+  std::string line;
+  while (ReadRequestLine(&line)) {
+    std::vector<std::string> paths = SplitWhitespace(line);
     if (paths.empty()) continue;
     if (classify_request(paths)) {
       // A failed execute is fatal (client state unknown); a decode
@@ -1122,6 +1172,7 @@ int main(int argc, char** argv) {
   if (argc >= 4 && std::strcmp(argv[1], "run") == 0) return Run(argc, argv);
   if (argc >= 4 && std::strcmp(argv[1], "serve") == 0) return Serve(argc, argv);
   if (argc >= 3 && std::strcmp(argv[1], "stage") == 0) return Stage(argc, argv);
+  if (argc >= 2 && std::strcmp(argv[1], "frame-check") == 0) return FrameCheck();
   std::fprintf(stderr,
                "usage:\n"
                "  pjrt_host probe <plugin.so> [client_options.txt]\n"
@@ -1132,6 +1183,8 @@ int main(int argc, char** argv) {
                "    pipelined passes, then one predict request per stdin line\n"
                "  pjrt_host stage <bundle_dir> --dir d --out staged.raw\n"
                "    hermetic: decode into the manifest's image layout, no TPU\n"
-               "    bundle: program.mlir + compile_options.pb + args.txt manifest\n");
+               "    bundle: program.mlir + compile_options.pb + args.txt manifest\n"
+               "  pjrt_host frame-check\n"
+               "    hermetic: echo serve's stdin request framing (tests)\n");
   return 2;
 }

@@ -41,5 +41,5 @@ try:
     from dmlc_tpu import native as _native  # noqa: E402
 
     _native.ensure_built()
-except Exception:
+except Exception:  # dmlc-lint: disable=E1 -- best-effort: tests that need the .so skip on native.available(), everything else must still collect
     pass

@@ -1,0 +1,434 @@
+"""dmlc-lint rule fixtures: every rule fires on its bad snippet, stays
+silent on the good one, and respects ``# dmlc-lint: disable=`` comments.
+
+Rules are exercised through ``lint_source`` (one file's source + a fake
+repo-relative path, so path-scoping is tested too); the final test runs
+the real CLI over the real tree — the repo itself must lint clean, which
+is the acceptance bar tools/ci_check.sh enforces.
+"""
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+from tools.lint.core import lint_source
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def fired(src: str, relpath: str) -> list[str]:
+    return [f.rule for f in lint_source(textwrap.dedent(src), relpath)]
+
+
+# ---------------------------------------------------------------------------
+# D1 — wall clock / ambient randomness in cluster/
+# ---------------------------------------------------------------------------
+
+
+def test_d1_fires_on_wall_clock():
+    src = """
+    import time
+
+    def step():
+        return time.time()
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == ["D1"]
+
+
+def test_d1_resolves_import_aliases():
+    src = """
+    import time as _t
+    from time import monotonic
+
+    def f():
+        return _t.monotonic() + monotonic()
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == ["D1", "D1"]
+
+
+def test_d1_fires_on_global_rng_and_unseeded_random():
+    src = """
+    import random
+
+    a = random.randint(0, 5)
+    b = random.Random()
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == ["D1", "D1"]
+
+
+def test_d1_allows_seeded_random_and_injected_clock():
+    src = """
+    import random
+
+    def f(clock):
+        rng = random.Random(7)
+        return clock.now(), rng.random()
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == []
+
+
+def test_d1_scoped_to_cluster():
+    src = """
+    import time
+
+    t = time.time()
+    """
+    assert fired(src, "dmlc_tpu/parallel/x.py") == []
+    assert fired(src, "tests/x.py") == []
+
+
+def test_d1_suppression_with_justification():
+    src = """
+    import time
+
+    t = time.time()  # dmlc-lint: disable=D1 -- harness measures real wall time
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# J1 — host sync inside jit
+# ---------------------------------------------------------------------------
+
+
+def test_j1_fires_in_decorated_jit():
+    src = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def f(x):
+        y = np.asarray(x)
+        return x.item()
+    """
+    assert fired(src, "dmlc_tpu/parallel/x.py") == ["J1", "J1"]
+
+
+def test_j1_fires_in_partial_decorated_and_wrapped_jit():
+    src = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("n",))
+    def f(x, n):
+        return float(x)
+
+    def fwd(x):
+        return jax.block_until_ready(x)
+
+    compiled = jax.jit(fwd)
+    """
+    assert fired(src, "dmlc_tpu/ops/x.py") == ["J1", "J1"]
+
+
+def test_j1_silent_on_clean_jit_and_non_jit_code():
+    src = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    @jax.jit
+    def f(x):
+        scale = float(2.0)  # literal: not a traced-array sync
+        return jnp.argmax(x * scale, axis=-1)
+
+    def host_side(x):
+        return np.asarray(x)  # not under jit
+    """
+    assert fired(src, "dmlc_tpu/parallel/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# J2 — jit constructed in a loop
+# ---------------------------------------------------------------------------
+
+
+def test_j2_fires_on_jit_in_loop():
+    src = """
+    import jax
+
+    def serve(requests, g):
+        for _ in requests:
+            f = jax.jit(g)
+            f(1)
+    """
+    assert fired(src, "dmlc_tpu/parallel/x.py") == ["J2"]
+
+
+def test_j2_silent_on_hoisted_jit():
+    src = """
+    import jax
+
+    def build(g):
+        return jax.jit(g)
+    """
+    assert fired(src, "dmlc_tpu/parallel/x.py") == []
+
+
+def test_j2_suppression_on_preceding_line():
+    src = """
+    import jax
+
+    def compare(models):
+        for m in models:
+            # dmlc-lint: disable=J2 -- one compile per schedule is the comparison
+            out = jax.jit(m.apply)(1)
+    """
+    assert fired(src, "tests/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# J3 — train-step jit must donate
+# ---------------------------------------------------------------------------
+
+
+def test_j3_fires_on_undonated_train_step():
+    src = """
+    import jax
+
+    def train_step(state, batch):
+        return state
+
+    compiled = jax.jit(train_step)
+    """
+    assert fired(src, "dmlc_tpu/parallel/x.py") == ["J3"]
+
+
+def test_j3_fires_on_decorated_step_and_passes_with_donation():
+    bad = """
+    import jax
+
+    @jax.jit
+    def step_fn(state, x):
+        return state
+    """
+    good = """
+    import jax
+
+    @jax.jit(donate_argnums=0)
+    def step_fn(state, x):
+        return state
+
+    def train_step(state, batch):
+        return state
+
+    compiled = jax.jit(train_step, donate_argnames="state")
+    """
+    assert fired(bad, "dmlc_tpu/parallel/x.py") == ["J3"]
+    assert fired(good, "dmlc_tpu/parallel/x.py") == []
+
+
+def test_j3_exempts_tests():
+    src = """
+    import jax
+
+    def train_step(state, batch):
+        return state
+
+    compiled = jax.jit(train_step)
+    """
+    assert fired(src, "tests/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# L1 — blocking call under a lock
+# ---------------------------------------------------------------------------
+
+
+def test_l1_fires_on_rpc_and_sleep_under_lock():
+    src = """
+    import threading
+    import time
+
+    class S:
+        def __init__(self, rpc):
+            self.rpc = rpc
+            self._lock = threading.Lock()
+
+        def f(self):
+            with self._lock:
+                time.sleep(1.0)
+                return self.rpc.call("a", "m", {})
+    """
+    assert fired(src, "dmlc_tpu/scheduler/x.py") == ["L1", "L1"]
+
+
+def test_l1_tracks_same_class_method_calls():
+    src = """
+    import threading
+
+    class S:
+        def __init__(self, sdfs):
+            self.sdfs = sdfs
+            self._lock = threading.Lock()
+
+        def f(self):
+            with self._lock:
+                return self._helper()
+
+        def _helper(self):
+            return self.sdfs.get_bytes("name")
+    """
+    findings = lint_source(textwrap.dedent(src), "dmlc_tpu/cluster/x.py")
+    assert [f.rule for f in findings] == ["L1"]
+    # The finding points at the blocking line inside the CALLEE.
+    assert findings[0].line == 14
+
+
+def test_l1_silent_outside_lock_on_cv_wait_and_outside_scope():
+    src = """
+    import threading
+
+    class S:
+        def __init__(self, rpc):
+            self.rpc = rpc
+            self._lock = threading.Lock()
+            self._cv = threading.Condition(self._lock)
+
+        def f(self):
+            with self._lock:
+                self._cv.wait()  # releases the lock by contract
+                self.counter = 1
+            return self.rpc.call("a", "m", {})  # after release
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == []
+    bad = """
+    import threading, time
+
+    class S:
+        def f(self):
+            with self._lock:
+                time.sleep(1)
+    """
+    assert fired(bad, "dmlc_tpu/parallel/x.py") == []  # L1 scope excludes parallel/
+
+
+def test_l1_does_not_descend_into_closures():
+    src = """
+    import threading
+
+    class S:
+        def f(self):
+            with self._lock:
+                def later():
+                    return self.rpc.call("a", "m", {})  # runs after release
+                self.pending = later
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# E1 — swallowed exceptions
+# ---------------------------------------------------------------------------
+
+
+def test_e1_fires_on_bare_except_and_silent_broad_except():
+    src = """
+    def f():
+        try:
+            g()
+        except:
+            pass
+
+    def h():
+        try:
+            g()
+        except Exception:
+            pass
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == ["E1", "E1"]
+
+
+def test_e1_allows_specific_and_observed_handlers():
+    src = """
+    import logging
+
+    log = logging.getLogger(__name__)
+
+    def f():
+        try:
+            g()
+        except ValueError:
+            pass  # specific type: an explicit decision
+        try:
+            g()
+        except Exception:
+            log.exception("observed")
+        try:
+            g()
+        except BaseException:
+            cleanup()
+            raise
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# S1 — suppressions need justification
+# ---------------------------------------------------------------------------
+
+
+def test_s1_fires_on_unjustified_suppression():
+    src = """
+    import time
+
+    t = time.time()  # dmlc-lint: disable=D1
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == ["S1"]
+
+
+def test_suppression_only_covers_named_rules():
+    src = """
+    import time
+
+    def f():
+        return time.time()  # dmlc-lint: disable=E1 -- wrong rule named
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == ["D1"]
+
+
+def test_suppression_in_string_literal_is_inert():
+    src = '''
+    import time
+
+    DOC = "# dmlc-lint: disable=D1 -- this is data, not a comment"
+    t = time.time()
+    '''
+    assert fired(src, "dmlc_tpu/cluster/x.py") == ["D1"]
+
+
+# ---------------------------------------------------------------------------
+# the real tree + the CLI contract
+# ---------------------------------------------------------------------------
+
+
+def test_repo_lints_clean():
+    """The acceptance bar: the shipped tree has zero unsuppressed findings
+    (every suppression carries a justification, or S1 would fire)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "dmlc_tpu/", "tools/", "tests/"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert r.returncode == 0, f"dmlc-lint found:\n{r.stdout}"
+
+
+def test_cli_lists_all_rules_and_exits_nonzero_on_findings(tmp_path):
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--list-rules"],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0
+    for rule_id in ("D1", "J1", "J2", "J3", "L1", "E1", "S1"):
+        assert rule_id in r.stdout
+    bad = tmp_path / "dmlc_tpu" / "cluster"
+    bad.mkdir(parents=True)
+    (bad / "x.py").write_text("import time\nt = time.time()\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.lint", str(bad / "x.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1 and "D1" in r.stdout

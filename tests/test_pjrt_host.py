@@ -141,6 +141,48 @@ class TestStageContract:
         assert r.returncode == 1 and "no JPEGs" in r.stderr
 
 
+class TestServeRequestFraming:
+    """`frame-check` runs the EXACT stdin framing serve's request loop
+    uses (ReadRequestLine/SplitWhitespace) with no plugin and no TPU.
+    Regression for the 64 KiB fgets truncation: a request line longer
+    than the read buffer used to split into multiple bogus requests
+    (with a mangled path at each seam) answered by multiple reply lines,
+    desyncing the line-framed request/response contract."""
+
+    def _frames(self, host_binary, payload: bytes):
+        r = subprocess.run(
+            [str(host_binary), "frame-check"],
+            input=payload, capture_output=True, timeout=120,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        return [json.loads(l) for l in r.stdout.decode().splitlines()]
+
+    def test_long_request_line_is_one_request(self, host_binary):
+        paths = [f"/data/corpus/img{i:06d}.jpg" for i in range(8000)]
+        line = " ".join(paths)
+        assert len(line) > 3 * 65536  # well past the old fgets buffer
+        replies = self._frames(host_binary, (line + "\n").encode())
+        assert len(replies) == 1
+        assert replies[0]["paths"] == len(paths)
+
+    def test_path_at_buffer_seam_not_mangled(self, host_binary):
+        # One token straddling the 64 KiB boundary: under the old fgets
+        # loop it split into two half-paths across two requests.
+        a = "a" * 65530
+        replies = self._frames(host_binary, f"{a} {'b' * 100}\n".encode())
+        assert len(replies) == 1 and replies[0]["paths"] == 2
+
+    def test_many_lines_map_one_to_one(self, host_binary):
+        payload = b"x.jpg y.jpg\n\n   \nz.jpg\n"
+        replies = self._frames(host_binary, payload)
+        # Blank/whitespace lines produce no reply, like serve's loop.
+        assert [r["paths"] for r in replies] == [2, 1]
+
+    def test_final_unterminated_line_still_answers(self, host_binary):
+        replies = self._frames(host_binary, b"x.jpg y.jpg")  # no trailing \n
+        assert [r["paths"] for r in replies] == [2]
+
+
 def test_probe_bad_plugin_reports_json(host_binary, tmp_path):
     bogus = tmp_path / "not_a_plugin.so"
     bogus.write_bytes(b"\x7fELF junk")

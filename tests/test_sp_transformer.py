@@ -42,11 +42,13 @@ def test_schedules_agree_inside_the_model(setup):
     tokens_sharded = jax.device_put(tokens, shd)
     for schedule in ("ring", "ring_flash", "ulysses"):
         model = build(mesh, schedule)
+        # dmlc-lint: disable=J2 -- each iteration jits a DIFFERENT schedule's model; one compile per schedule is the comparison itself
         got = np.asarray(jax.jit(model.apply)(variables, tokens_sharded))
         np.testing.assert_allclose(got, want, atol=3e-5, rtol=1e-4)
     # The single-device Pallas flash schedule agrees too (same params),
     # and so does the crossover-dispatched "auto" schedule.
     for schedule in ("flash", "auto"):
+        # dmlc-lint: disable=J2 -- each iteration jits a DIFFERENT schedule's model; one compile per schedule is the comparison itself
         got = np.asarray(jax.jit(build(None, schedule).apply)(variables, tokens))
         np.testing.assert_allclose(got, want, atol=3e-5, rtol=1e-4)
 

@@ -117,3 +117,53 @@ class TestConfig:
         p.write_text('{"nope": 1}')
         with pytest.raises(ValueError):
             ClusterConfig.from_json(p)
+
+
+class TestCorpusRegeneration:
+    """A corpus-kind (or shape) mismatch must WIPE the stale train/ tree
+    before regenerating: the generators write only the first n_classes
+    dirs / images_per_class files, so without the wipe leftover class
+    dirs from the previous corpus would survive under the new
+    .corpus_kind marker and any consumer that globs class dirs would see
+    mixed-kind data."""
+
+    def test_kind_switch_leaves_no_stale_class_dirs(self, tmp_path):
+        from dmlc_tpu.utils import corpus
+
+        root = tmp_path / "c"
+        corpus.generate(root, n_classes=6, images_per_class=2, size=16)
+        assert len(list((root / "train").iterdir())) == 6
+        # Regenerate the SAME root as a smaller learnable corpus: classes
+        # 4..5 of the iid corpus must not survive the kind switch.
+        data_dir, _ = corpus.generate_learnable(
+            root, n_classes=4, images_per_class=3, size=16
+        )
+        dirs = sorted(d.name for d in data_dir.iterdir() if d.is_dir())
+        assert dirs == [f"n{i:08d}" for i in range(4)]
+        assert (root / ".corpus_kind").read_text().strip() == "learnable"
+        # And every class dir holds exactly the new image count.
+        for d in data_dir.iterdir():
+            assert len(list(d.iterdir())) == 3
+
+    def test_shape_mismatch_same_kind_also_regenerates_clean(self, tmp_path):
+        from dmlc_tpu.utils import corpus
+
+        root = tmp_path / "c"
+        corpus.generate(root, n_classes=8, images_per_class=1, size=16)
+        # Bigger per-class request, same kind: not reusable -> clean slate,
+        # not an in-place rewrite that leaves dirs 6..7 at 1 image.
+        data_dir, _ = corpus.generate(root, n_classes=6, images_per_class=2, size=16)
+        dirs = sorted(d.name for d in data_dir.iterdir() if d.is_dir())
+        assert dirs == [f"n{i:08d}" for i in range(6)]
+        for d in data_dir.iterdir():
+            assert len(list(d.iterdir())) == 2
+
+    def test_matching_corpus_is_still_reused(self, tmp_path):
+        from dmlc_tpu.utils import corpus
+
+        root = tmp_path / "c"
+        data_dir, _ = corpus.generate(root, n_classes=3, images_per_class=1, size=16)
+        marker = root / "train" / "n00000000" / "img0.jpg"
+        before = marker.stat().st_mtime_ns
+        corpus.generate(root, n_classes=3, images_per_class=1, size=16)
+        assert marker.stat().st_mtime_ns == before  # untouched, not rewritten

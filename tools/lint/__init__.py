@@ -1,0 +1,40 @@
+"""dmlc-lint: project-invariant static analysis for the dmlc_tpu tree.
+
+The reference got memory- and thread-safety from Rust for free; the port
+recovers the native side via the ASan/TSan harness (native/Makefile), and
+THIS package guards the Python control plane, where the invariants that
+rustc cannot see live:
+
+- **D1** sans-IO determinism: no wall-clock or ambient randomness inside
+  ``dmlc_tpu/cluster/`` — inject a ``Clock`` (cluster/clock.py) or a
+  seeded RNG so the simulator stays deterministic.
+- **J1** no host sync inside jit: ``.item()``, ``float()/int()`` on
+  arrays, ``np.asarray``, ``block_until_ready`` inside a jit-compiled
+  function either breaks tracing or silently serializes the device
+  pipeline.
+- **J2** no jit construction in a loop / per-request path: every
+  ``jax.jit`` call makes a fresh cache, so a loop-local jit recompiles
+  per iteration.
+- **J3** train-step jits must donate their state buffers
+  (``donate_argnums``/``donate_argnames``) or HBM holds two copies of
+  params + optimizer state.
+- **L1** no blocking call (RPC, socket op, sleep, SDFS transfer, future
+  wait) while holding a ``threading.Lock``/``RLock`` in ``cluster/`` and
+  ``scheduler/`` — tracked across ``with self._lock:`` bodies including
+  same-class methods they call.
+- **E1** no bare ``except:`` and no ``except Exception: pass`` — a
+  swallowed exception in failure-detection/healing paths turns a crash
+  into a silent wedge.
+- **S1** every ``# dmlc-lint: disable=RULE`` suppression must carry a
+  justification (``-- why``).
+
+Run: ``python -m tools.lint [paths...]`` (default: ``dmlc_tpu/ tools/
+tests/``); exits nonzero on findings. Suppress a finding with a trailing
+or preceding-line comment::
+
+    x = time.time()  # dmlc-lint: disable=D1 -- harness measures real wall time
+
+See docs/LINT.md for the full rule catalogue.
+"""
+
+from tools.lint.core import main, run  # noqa: F401

@@ -1,0 +1,5 @@
+import sys
+
+from tools.lint.core import main
+
+sys.exit(main())

@@ -1,0 +1,181 @@
+"""Linter driver: file walking, suppression handling, finding report.
+
+The AST rules live in ``tools/lint/rules``; this module owns everything
+rule-independent — collecting ``*.py`` files, parsing ``# dmlc-lint:
+disable=...`` comments with the tokenizer (so strings that *look* like
+comments never suppress anything), applying them, and rendering findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import io
+import re
+import sys
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+
+#: ``# dmlc-lint: disable=D1,L1 -- justification`` — the justification
+#: (everything after ``--``) is mandatory; rule S1 enforces it.
+_SUPPRESS_RE = re.compile(
+    r"#\s*dmlc-lint:\s*disable=([A-Z]\d+(?:\s*,\s*[A-Z]\d+)*)\s*(?:--\s*(\S.*))?"
+)
+
+DEFAULT_PATHS = ("dmlc_tpu", "tools", "tests")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str          # repo-relative, forward slashes
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def render(self, hints: dict[str, str]) -> str:
+        out = f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+        hint = hints.get(self.rule)
+        if hint:
+            out += f"\n    fix: {hint}"
+        return out
+
+
+@dataclass
+class Suppression:
+    line: int               # the source line the comment sits on
+    rules: tuple[str, ...]
+    justified: bool
+    used: set[str] = field(default_factory=set)
+
+
+def _collect_suppressions(src: str) -> list[Suppression]:
+    out: list[Suppression] = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(src).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m is None:
+                continue
+            rules = tuple(r.strip() for r in m.group(1).split(","))
+            out.append(Suppression(tok.start[0], rules, m.group(2) is not None))
+    except tokenize.TokenError:
+        pass  # a syntax error will be reported by the parse step instead
+    return out
+
+
+def _apply_suppressions(
+    findings: list[Finding], sups: list[Suppression]
+) -> list[Finding]:
+    """A comment suppresses its own line; a comment-only line also covers
+    the next line (the conventional 'disable-next-line' placement)."""
+    by_line: dict[int, list[Suppression]] = {}
+    for s in sups:
+        by_line.setdefault(s.line, []).append(s)
+        by_line.setdefault(s.line + 1, []).append(s)
+    kept = []
+    for f in findings:
+        hit = next(
+            (s for s in by_line.get(f.line, ()) if f.rule in s.rules), None
+        )
+        if hit is None:
+            kept.append(f)
+        else:
+            hit.used.add(f.rule)
+    return kept
+
+
+def _iter_files(paths: list[str]) -> list[Path]:
+    files: list[Path] = []
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            files.extend(
+                f for f in sorted(path.rglob("*.py"))
+                if not any(part.startswith(".") for part in f.parts)
+            )
+        elif path.suffix == ".py":
+            files.append(path)
+    return files
+
+
+def lint_source(src: str, relpath: str) -> list[Finding]:
+    """Run every applicable rule over one file's source. Suppressions are
+    applied; unjustified suppression comments surface as S1 findings."""
+    from tools.lint.rules import RULES
+
+    try:
+        tree = ast.parse(src, filename=relpath)
+    except SyntaxError as e:
+        return [Finding(relpath, e.lineno or 1, e.offset or 0, "X0",
+                        f"syntax error: {e.msg}")]
+    findings: list[Finding] = []
+    for rule in RULES:
+        if rule.applies(relpath):
+            findings.extend(rule.check(tree, relpath))
+    sups = _collect_suppressions(src)
+    findings = _apply_suppressions(findings, sups)
+    for s in sups:
+        if not s.justified:
+            findings.append(Finding(
+                relpath, s.line, 0, "S1",
+                "suppression without a justification: append "
+                "'-- <why this invariant is safe to break here>'",
+            ))
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def run(paths: list[str]) -> list[Finding]:
+    root = Path.cwd()
+    findings: list[Finding] = []
+    for f in _iter_files(paths):
+        try:
+            rel = f.resolve().relative_to(root).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        findings.extend(lint_source(f.read_text(encoding="utf-8"), rel))
+    return findings
+
+
+def _list_rules() -> str:
+    from tools.lint.rules import RULES
+
+    lines = []
+    for rule in RULES:
+        lines.append(f"{rule.id}  {rule.summary}")
+        lines.append(f"    scope: {rule.scope_doc}")
+        lines.append(f"    fix:   {rule.hint}")
+    lines.append("S1  every '# dmlc-lint: disable=RULE' must carry a "
+                 "justification ('-- why')")
+    lines.append("    scope: everywhere")
+    lines.append("    fix:   explain why the invariant is safe to break, or "
+                 "remove the suppression")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    from tools.lint.rules import RULES
+
+    parser = argparse.ArgumentParser(
+        prog="dmlc-lint",
+        description="Project-invariant static analysis (see docs/LINT.md).",
+    )
+    parser.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS))
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalogue and exit")
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+    findings = run(args.paths)
+    hints = {r.id: r.hint for r in RULES}
+    for f in findings:
+        print(f.render(hints))
+    if findings:
+        print(f"dmlc-lint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0

@@ -1,0 +1,67 @@
+"""Rule registry + AST helpers shared by every rule module.
+
+A rule is a module-level object with ``id``, ``summary``, ``hint``,
+``scope_doc``, ``applies(relpath) -> bool`` and
+``check(tree, relpath) -> list[Finding]``. Rules are pure functions of
+one file's AST — cross-file analysis is deliberately out of scope (the
+invariants here are all expressible file-locally, and file-local keeps
+the linter fast enough to run on every commit).
+"""
+
+from __future__ import annotations
+
+import ast
+
+
+def dotted_name(node: ast.expr) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain rooted at a Name, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ImportMap:
+    """Resolve local names through the file's imports to canonical dotted
+    paths: ``import time as _t`` makes ``_t.monotonic`` resolve to
+    ``time.monotonic``; ``from time import sleep`` makes ``sleep``
+    resolve to ``time.sleep``."""
+
+    def __init__(self, tree: ast.AST):
+        self.aliases: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+                for a in node.names:
+                    if a.name != "*":
+                        self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def resolve(self, name: str | None) -> str | None:
+        if name is None:
+            return None
+        head, _, rest = name.partition(".")
+        head = self.aliases.get(head, head)
+        return f"{head}.{rest}" if rest else head
+
+    def resolve_node(self, node: ast.expr) -> str | None:
+        return self.resolve(dotted_name(node))
+
+
+from tools.lint.rules import excepts, jit, locks, wallclock  # noqa: E402
+
+RULES = [
+    wallclock.D1,
+    jit.J1,
+    jit.J2,
+    jit.J3,
+    locks.L1,
+    excepts.E1,
+]

@@ -1,0 +1,76 @@
+"""E1 — exception swallowing in failure-detection and healing paths.
+
+The cluster's whole value proposition is noticing failures (membership
+detector, SDFS healing, leader failover). A bare ``except:`` or an
+``except Exception: pass`` in that machinery converts a crash — which a
+supervisor or a test would catch — into a silent wedge that only shows
+up as "the cluster stopped healing" hours later. Broad handlers that
+*do something* (log, count, return a fallback, re-raise) are fine; what
+this rule bans is the broad handler whose body is nothing at all.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Finding
+from tools.lint.rules import ImportMap
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(type_node: ast.expr | None, imports: ImportMap) -> bool:
+    if type_node is None:
+        return True
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(e, imports) for e in type_node.elts)
+    name = imports.resolve_node(type_node)
+    return name in _BROAD or (name or "").rsplit(".", 1)[-1] in _BROAD
+
+
+def _body_is_empty(body: list[ast.stmt]) -> bool:
+    """Only pass/continue/``...`` — nothing observed, nothing raised."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is Ellipsis):
+            continue
+        return False
+    return True
+
+
+class _E1:
+    id = "E1"
+    summary = "bare except / broad except with an empty body"
+    hint = ("catch the specific exceptions you expect, or at minimum "
+            "log.exception(...) so the failure is observable; re-raise "
+            "anything you cannot handle")
+    scope_doc = "everywhere scanned"
+
+    def applies(self, relpath: str) -> bool:
+        return True
+
+    def check(self, tree: ast.AST, relpath: str) -> list[Finding]:
+        imports = ImportMap(tree)
+        findings = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                findings.append(Finding(
+                    relpath, node.lineno, node.col_offset, self.id,
+                    "bare 'except:' also swallows KeyboardInterrupt/"
+                    "SystemExit; name the exceptions you expect",
+                ))
+            elif _is_broad(node.type, imports) and _body_is_empty(node.body):
+                findings.append(Finding(
+                    relpath, node.lineno, node.col_offset, self.id,
+                    "broad except with an empty body silently swallows "
+                    "every failure on this path",
+                ))
+        return findings
+
+
+E1 = _E1()

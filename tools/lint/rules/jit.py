@@ -1,0 +1,213 @@
+"""J1/J2/J3 — jit hygiene: host syncs, recompile hazards, donation.
+
+TPU perf regressions are dominated by two silent hazards (see
+arXiv:2503.01025 / arXiv:2604.15464 and ROADMAP's "fast as the hardware
+allows"): host synchronization inside a compiled program's dispatch path,
+and per-request recompilation. Neither raises; both show up only in the
+benchmark — exactly the class of bug to catch statically.
+
+Jit contexts are found two ways: functions *decorated* with
+``@jax.jit`` / ``@partial(jax.jit, ...)`` / ``@pjit``, and local
+functions *wrapped* later (``compiled = jax.jit(fn, ...)`` — the
+dominant idiom in parallel/train.py and parallel/inference.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.lint.core import Finding
+from tools.lint.rules import ImportMap
+
+_JIT_NAMES = {"jax.jit", "jax.pjit", "pjit", "jax.experimental.pjit.pjit"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+_LOOPS = (ast.For, ast.While, ast.AsyncFor,
+          ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+#: Function names that identify a *training step*: the state they take is
+#: dead the moment the new state returns, so not donating it doubles the
+#: HBM held by params + optimizer state.
+_STEP_RE = re.compile(r"(^|_)(train_?step|step(_fn)?|update(_fn|_step)?)$")
+
+_HOST_SYNC_METHODS = {
+    "item": "forces a device->host transfer per element",
+    "block_until_ready": "serializes the device pipeline inside the program",
+    "tolist": "forces a full device->host transfer",
+}
+_HOST_SYNC_FUNCS = {
+    "jax.block_until_ready": "serializes the device pipeline",
+    "jax.device_get": "forces a device->host transfer",
+    "numpy.asarray": "materializes the traced array on the host",
+    "numpy.array": "materializes the traced array on the host",
+    "numpy.frombuffer": "reads host memory during trace",
+}
+
+
+def _is_jit_name(expr: ast.expr, imports: ImportMap) -> bool:
+    return imports.resolve_node(expr) in _JIT_NAMES
+
+
+def _decorator_is_jit(dec: ast.expr, imports: ImportMap) -> bool:
+    """``@jax.jit``, ``@pjit``, ``@jax.jit(...)`` or
+    ``@partial(jax.jit, ...)``."""
+    if _is_jit_name(dec, imports):
+        return True
+    if isinstance(dec, ast.Call):
+        if _is_jit_name(dec.func, imports):
+            return True
+        if (imports.resolve_node(dec.func) in _PARTIAL_NAMES and dec.args
+                and _is_jit_name(dec.args[0], imports)):
+            return True
+    return False
+
+
+def _jit_call_kwargs(call: ast.Call) -> set[str]:
+    return {kw.arg for kw in call.keywords if kw.arg}
+
+
+def _decorator_kwargs(dec: ast.expr) -> set[str]:
+    return _jit_call_kwargs(dec) if isinstance(dec, ast.Call) else set()
+
+
+def _jit_contexts(tree: ast.AST, imports: ImportMap) -> list[ast.FunctionDef]:
+    """Every FunctionDef that runs under trace: decorated with jit, or
+    referenced by name as the first argument of a jit(...) call anywhere
+    in the file."""
+    defs: dict[str, list[ast.FunctionDef]] = {}
+    contexts: list[ast.FunctionDef] = []
+    wrapped: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+            if any(_decorator_is_jit(d, imports) for d in node.decorator_list):
+                contexts.append(node)
+        elif isinstance(node, ast.Call) and _is_jit_name(node.func, imports):
+            if node.args and isinstance(node.args[0], ast.Name):
+                wrapped.add(node.args[0].id)
+    for name in wrapped:
+        contexts.extend(defs.get(name, ()))
+    return contexts
+
+
+class _J1:
+    id = "J1"
+    summary = "host synchronization inside a jit-compiled function"
+    hint = ("keep the whole function traceable: use jnp ops on traced values "
+            "and move host readback outside the compiled program")
+    scope_doc = "dmlc_tpu/parallel/, dmlc_tpu/ops/"
+
+    def applies(self, relpath: str) -> bool:
+        return "dmlc_tpu/parallel/" in relpath or "dmlc_tpu/ops/" in relpath
+
+    def check(self, tree: ast.AST, relpath: str) -> list[Finding]:
+        imports = ImportMap(tree)
+        findings = []
+        for fn in _jit_contexts(tree, imports):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Attribute):
+                    why = _HOST_SYNC_METHODS.get(node.func.attr)
+                    if why is not None and not node.args:
+                        findings.append(Finding(
+                            relpath, node.lineno, node.col_offset, self.id,
+                            f".{node.func.attr}() inside jit function "
+                            f"{fn.name!r}: {why}",
+                        ))
+                        continue
+                name = imports.resolve_node(node.func)
+                why = _HOST_SYNC_FUNCS.get(name or "")
+                if why is not None:
+                    findings.append(Finding(
+                        relpath, node.lineno, node.col_offset, self.id,
+                        f"{name}() inside jit function {fn.name!r}: {why}",
+                    ))
+                elif (name in ("float", "int") and node.args
+                      and not all(isinstance(a, ast.Constant) for a in node.args)):
+                    findings.append(Finding(
+                        relpath, node.lineno, node.col_offset, self.id,
+                        f"{name}() on a non-literal inside jit function "
+                        f"{fn.name!r}: on a traced array this is a host sync "
+                        "(ConcretizationTypeError at best)",
+                    ))
+        return findings
+
+
+class _J2:
+    id = "J2"
+    summary = "jit constructed inside a loop (recompile hazard)"
+    hint = ("hoist the jax.jit/pjit call to module level or cache the "
+            "compiled function (e.g. on self/functools.lru_cache) so each "
+            "signature compiles once")
+    scope_doc = "everywhere scanned"
+
+    def applies(self, relpath: str) -> bool:
+        return True
+
+    def check(self, tree: ast.AST, relpath: str) -> list[Finding]:
+        imports = ImportMap(tree)
+        findings = []
+
+        def visit(node: ast.AST, loop_depth: int) -> None:
+            for child in ast.iter_child_nodes(node):
+                depth = loop_depth + isinstance(child, _LOOPS)
+                if (isinstance(child, ast.Call)
+                        and _is_jit_name(child.func, imports)
+                        and loop_depth > 0):
+                    findings.append(Finding(
+                        relpath, child.lineno, child.col_offset, self.id,
+                        "jit constructed inside a loop: every call makes a "
+                        "fresh compilation cache, so this recompiles per "
+                        "iteration",
+                    ))
+                visit(child, depth)
+
+        visit(tree, 0)
+        return findings
+
+
+class _J3:
+    id = "J3"
+    summary = "train-step jit without buffer donation"
+    hint = ("pass donate_argnums/donate_argnames for the state argument so "
+            "XLA reuses the old params/opt-state buffers instead of holding "
+            "both generations in HBM")
+    scope_doc = "dmlc_tpu/ (product code; tests exempt)"
+
+    def applies(self, relpath: str) -> bool:
+        return "dmlc_tpu/" in relpath
+
+    def check(self, tree: ast.AST, relpath: str) -> list[Finding]:
+        imports = ImportMap(tree)
+        findings = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if not _STEP_RE.search(node.name):
+                    continue
+                for dec in node.decorator_list:
+                    if _decorator_is_jit(dec, imports) and not (
+                        _decorator_kwargs(dec) & {"donate_argnums", "donate_argnames"}
+                    ):
+                        findings.append(Finding(
+                            relpath, dec.lineno, dec.col_offset, self.id,
+                            f"jit-decorated train step {node.name!r} does "
+                            "not donate its state buffers",
+                        ))
+            elif (isinstance(node, ast.Call)
+                  and _is_jit_name(node.func, imports)
+                  and node.args and isinstance(node.args[0], ast.Name)
+                  and _STEP_RE.search(node.args[0].id)
+                  and not (_jit_call_kwargs(node)
+                           & {"donate_argnums", "donate_argnames"})):
+                findings.append(Finding(
+                    relpath, node.lineno, node.col_offset, self.id,
+                    f"jit of train step {node.args[0].id!r} does not donate "
+                    "its state buffers",
+                ))
+        return findings
+
+
+J1 = _J1()
+J2 = _J2()
+J3 = _J3()

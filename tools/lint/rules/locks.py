@@ -1,0 +1,188 @@
+"""L1 — no blocking call while holding a threading lock.
+
+The cluster/scheduler locks guard in-memory state and are taken on hot
+paths (every RPC dispatch, every membership step). A blocking call under
+one — an RPC, a socket op, a sleep, an SDFS transfer, a future wait —
+turns every other thread contending for that lock into a convoy behind
+the network, and is one unlucky dependency cycle away from deadlock.
+
+Detection: any ``with <expr>:`` whose context expression's final name
+contains "lock" opens a lock scope; blocking calls are flagged inside
+that scope AND inside same-class methods it calls (``self.helper()`` is
+followed one class deep with a visited set — the ``with self._lock:
+self._do_it()`` idiom must not hide the blocking call in ``_do_it``).
+Closures/defs created under the lock are NOT scanned: they typically run
+after release (thread pools, callbacks). Condition variables
+(names containing "cond"/"cv") are exempt — ``cv.wait()`` *releases*
+the lock by contract.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Finding
+from tools.lint.rules import ImportMap, dotted_name
+
+#: Socket-level methods that block regardless of receiver.
+_BLOCKING_METHODS = {
+    "sendall", "recv", "recv_into", "recvfrom", "accept", "connect",
+    "makefile",
+}
+#: SDFS client verbs: each is at least one network round-trip, often a
+#: chunked multi-frame transfer.
+_SDFS_METHODS = {
+    "get", "put", "get_bytes", "put_bytes", "get_versions", "delete",
+    "ls", "store", "replicate",
+}
+_BLOCKING_FUNCS = {
+    "time.sleep": "sleeps",
+    "socket.create_connection": "dials TCP",
+    "concurrent.futures.wait": "waits on futures",
+}
+_BLOCKING_PREFIXES = ("subprocess.",)
+
+
+def _lock_name(expr: ast.expr) -> str | None:
+    """The lock's display name when ``expr`` looks like a lock, else None."""
+    name = dotted_name(expr)
+    if name is None:
+        return None
+    last = name.rsplit(".", 1)[-1].lower()
+    if "lock" in last and "cond" not in last and "cv" not in last:
+        return name
+    return None
+
+
+def _receiver_name(func: ast.expr) -> str:
+    if isinstance(func, ast.Attribute):
+        return (dotted_name(func.value) or "").lower()
+    return ""
+
+
+def _blocking_reason(call: ast.Call, imports: ImportMap) -> str | None:
+    """Why this call blocks, or None if it does not (statically)."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        attr = func.attr
+        recv = _receiver_name(func)
+        if attr in _BLOCKING_METHODS:
+            return f"socket operation .{attr}()"
+        if attr == "call" and "rpc" in recv:
+            return f"RPC {dotted_name(func)}() (network round-trip)"
+        if attr in _SDFS_METHODS and "sdfs" in recv:
+            return f"SDFS transfer {dotted_name(func)}()"
+        if attr == "result":
+            return f"future wait {dotted_name(func)}()"
+        if attr == "wait" and "cond" not in recv and "cv" not in recv:
+            return f"blocking wait {dotted_name(func)}()"
+    name = imports.resolve_node(func)
+    if name in _BLOCKING_FUNCS:
+        return f"{name}() {_BLOCKING_FUNCS[name]}"
+    if name and name.startswith(_BLOCKING_PREFIXES):
+        return f"subprocess call {name}()"
+    return None
+
+
+class _L1:
+    id = "L1"
+    summary = "blocking call while holding a threading lock"
+    hint = ("copy what you need under the lock, release it, then do the "
+            "network/disk/wait work outside the critical section")
+    scope_doc = "dmlc_tpu/cluster/, dmlc_tpu/scheduler/"
+
+    def applies(self, relpath: str) -> bool:
+        return "dmlc_tpu/cluster/" in relpath or "dmlc_tpu/scheduler/" in relpath
+
+    def check(self, tree: ast.AST, relpath: str) -> list[Finding]:
+        imports = ImportMap(tree)
+        findings: list[Finding] = []
+
+        def scan_stmts(stmts, lock: str, lock_line: int, methods, visited):
+            for stmt in stmts:
+                self._scan_node(stmt, lock, lock_line, methods, visited,
+                                findings, relpath, imports)
+
+        for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+            methods = {
+                m.name: m for m in cls.body
+                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            for method in methods.values():
+                for node in ast.walk(method):
+                    if not isinstance(node, ast.With):
+                        continue
+                    for item in node.items:
+                        lock = _lock_name(item.context_expr)
+                        if lock is not None:
+                            scan_stmts(node.body, lock, node.lineno,
+                                       methods, set())
+        # Locks in module-level functions (no same-class recursion there).
+        module_fns = [
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        in_class = {
+            id(m) for c in ast.walk(tree) if isinstance(c, ast.ClassDef)
+            for m in ast.walk(c)
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for fn in module_fns:
+            if id(fn) in in_class:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        lock = _lock_name(item.context_expr)
+                        if lock is not None:
+                            scan_stmts(node.body, lock, node.lineno, {}, set())
+        # A method reached both directly and through another method's lock
+        # scope would report the same line twice; keep one per location.
+        seen: set[tuple[int, int]] = set()
+        unique = []
+        for f in findings:
+            if (f.line, f.col) not in seen:
+                seen.add((f.line, f.col))
+                unique.append(f)
+        return unique
+
+    def _scan_node(self, root, lock, lock_line, methods, visited,
+                   findings, relpath, imports):
+        """Walk one statement without descending into nested function/lambda
+        bodies (they usually execute after the lock is released)."""
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                reason = _blocking_reason(node, imports)
+                if reason is not None:
+                    findings.append(Finding(
+                        relpath, node.lineno, node.col_offset, self.id,
+                        f"{reason} while holding {lock} "
+                        f"(acquired at line {lock_line})",
+                    ))
+                else:
+                    # Follow self.<method>() one class deep.
+                    func = node.func
+                    if (isinstance(func, ast.Attribute)
+                            and isinstance(func.value, ast.Name)
+                            and func.value.id == "self"
+                            and func.attr in methods
+                            and func.attr not in visited):
+                        visited.add(func.attr)
+                        callee = methods[func.attr]
+                        for stmt in callee.body:
+                            self._scan_node(
+                                stmt, lock,
+                                lock_line, methods, visited,
+                                findings, relpath, imports,
+                            )
+            stack.extend(ast.iter_child_nodes(node))
+    # NOTE: the callee's findings point at the blocking line inside the
+    # callee — that is where the suppression (or the fix) belongs.
+
+
+L1 = _L1()

@@ -1,0 +1,75 @@
+"""D1 — sans-IO determinism in ``dmlc_tpu/cluster/``.
+
+The cluster protocol core is a state machine advanced by ``step()`` with
+an injected ``Clock`` and ``Transport`` (cluster/membership.py's design
+note): that is what lets the deterministic simulator run whole
+crash/partition/rejoin scenarios in milliseconds. An ambient wall-clock
+read or a draw from the process-global ``random`` state re-couples the
+state machine to real time and makes simulated runs unrepeatable, so
+both are banned here. A *seeded* ``random.Random(seed)`` instance is
+allowed — it is exactly as injectable as a Clock.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Finding
+from tools.lint.rules import ImportMap
+
+_BANNED_CLOCKS = {
+    "time.time",
+    "time.time_ns",
+    "time.monotonic",
+    "time.monotonic_ns",
+    "time.perf_counter",
+    "time.perf_counter_ns",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.datetime.today",
+    "datetime.date.today",
+}
+
+
+class _D1:
+    id = "D1"
+    summary = ("wall-clock read or ambient randomness inside the sans-IO "
+               "cluster state machines")
+    hint = ("take a Clock (cluster/clock.py) or a seeded random.Random as a "
+            "constructor/function argument and read time/randomness from it")
+    scope_doc = "dmlc_tpu/cluster/"
+
+    def applies(self, relpath: str) -> bool:
+        return "dmlc_tpu/cluster/" in relpath
+
+    def check(self, tree: ast.AST, relpath: str) -> list[Finding]:
+        imports = ImportMap(tree)
+        findings = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = imports.resolve_node(node.func)
+            if name is None:
+                continue
+            if name in _BANNED_CLOCKS:
+                findings.append(Finding(
+                    relpath, node.lineno, node.col_offset, self.id,
+                    f"wall-clock call {name}() in sans-IO cluster code "
+                    "breaks simulator determinism",
+                ))
+            elif name == "random.Random" and not (node.args or node.keywords):
+                findings.append(Finding(
+                    relpath, node.lineno, node.col_offset, self.id,
+                    "unseeded random.Random() in sans-IO cluster code: "
+                    "seed it from injected state",
+                ))
+            elif name.startswith("random.") and name != "random.Random":
+                findings.append(Finding(
+                    relpath, node.lineno, node.col_offset, self.id,
+                    f"process-global RNG call {name}() in sans-IO cluster "
+                    "code breaks simulator determinism",
+                ))
+        return findings
+
+
+D1 = _D1()
