@@ -993,12 +993,21 @@ def bench_e2e(
         pp.load_batch(paths[s : s + batch_size], size=engine.input_size)
     decode_s = time.perf_counter() - t0
 
-    # Overlapped end-to-end (decode || transfer || device).
-    e2e_s = serial_s = None
+    # Overlapped end-to-end (decode || transfer || device), with the
+    # per-stage attribution the engine's ingest counters record: where the
+    # e2e seconds actually go (decode vs h2d staging vs dispatch vs sync).
+    e2e_s = serial_s = stage_seconds = None
     if time_left() > 0:
+        engine.reset_ingest_stats()
         t0 = time.perf_counter()
         engine.run_paths_stream(paths)
         e2e_s = time.perf_counter() - t0
+        ing = engine.ingest_summary()
+        stage_seconds = {
+            k: round(ing[k]["total_s"], 3)
+            for k in ("decode", "stage", "dispatch", "sync")
+            if k in ing
+        }
 
     # Serial reference (decode, then device, per batch) for the overlap win.
     if time_left() > 0:
@@ -1032,6 +1041,11 @@ def bench_e2e(
         "e2e_img_s": rate(e2e_s),
         "serial_img_s": rate(serial_s),
         "overlap_speedup": round(serial_s / e2e_s, 2) if e2e_s and serial_s else None,
+        # Per-stage busy seconds behind e2e_img_s (engine ingest counters):
+        # decode = host JPEG->uint8, stage = h2d device_put, dispatch =
+        # host-side XLA dispatch, sync = host stalls on device results. The
+        # dominant stage is the pipeline's bottleneck.
+        "stage_seconds": stage_seconds,
     }
 
 
@@ -1242,6 +1256,13 @@ def main() -> None:
                 f"overlap_speedup={e2e['overlap_speedup']}x",
                 file=sys.stderr,
             )
+            stages = e2e.get("stage_seconds")
+            if stages:
+                print(
+                    "[bench-e2e] stage breakdown (busy seconds): "
+                    + " ".join(f"{k}={stages[k]}" for k in sorted(stages)),
+                    file=sys.stderr,
+                )
         except Exception as e:
             print(f"[bench-e2e] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
 

@@ -37,6 +37,7 @@ from dmlc_tpu.cluster.sdfs import MemberStore, SdfsClient, SdfsLeader, SdfsMembe
 from dmlc_tpu.cluster.transport import UdpTransport
 from dmlc_tpu.scheduler.jobs import JobScheduler
 from dmlc_tpu.scheduler.worker import (
+    DynamicBatcher,
     EngineBackend,
     ExportedBackend,
     ModelLoader,
@@ -167,6 +168,23 @@ class ClusterNode:
                 if hasattr(backend, "image_source") and backend.image_source is None:
                     backend.image_source = source
 
+        # Dynamic request micro-batching, wrapped LAST so the wiring above
+        # (sdfs / image_source assignment) still hits the raw backends. With
+        # a deadline configured, concurrent small `job.predict` RPCs
+        # coalesce into device-shaped batches (scheduler/worker.py); gang
+        # verbs pass through the wrapper untouched.
+        self._batchers: list[DynamicBatcher] = []
+        if config.microbatch_wait_s > 0:
+            for name, backend in list(self.worker.backends.items()):
+                wrapped = DynamicBatcher(
+                    backend,
+                    batch_size=config.batch_size,
+                    max_wait_s=config.microbatch_wait_s,
+                    name=f"microbatch-{name}",
+                )
+                self.worker.backends[name] = wrapped
+                self._batchers.append(wrapped)
+
     # ---- leader side ---------------------------------------------------
 
     def _load_workload(self) -> list[tuple[str, int]]:
@@ -248,7 +266,17 @@ class ClusterNode:
                 chips = jax.local_device_count() if jax is not None else 1
             except Exception:
                 chips = 1
-        return {"chips": int(chips)}
+        info: dict = {"chips": int(chips)}
+        if self._batchers:
+            # Micro-batching observability: per-model coalescing counters
+            # (docs/INGEST.md) ride the same member-info RPC the leader
+            # already polls for capacity.
+            info["microbatch"] = {
+                name: b.summary()
+                for name, b in self.worker.backends.items()
+                if isinstance(b, DynamicBatcher)
+            }
+        return info
 
     def _member_weight(self, addr: str) -> int:
         """TTL-cached node.info lookup used by the scheduler's assignment
@@ -310,6 +338,8 @@ class ClusterNode:
 
     def stop(self) -> None:
         self._stop.set()
+        for b in self._batchers:
+            b.stop(timeout_s=2.0)
         for t in self._threads:
             t.join(timeout=2.0)
         self.member_server.close()

@@ -21,7 +21,9 @@ log = logging.getLogger(__name__)
 
 _LIB_PATH = Path(__file__).parent / "libdmlc_native.so"
 _SRC_DIR = Path(__file__).parent.parent.parent / "native"
-_ABI_VERSION = 1
+# v2: persistent decode pool (dmlc_pool_size/dmlc_pool_shutdown) replacing
+# the spawn-and-join-per-call threading of v1.
+_ABI_VERSION = 2
 
 _lib = None
 _load_failed = False
@@ -51,6 +53,10 @@ def _load():
             ctypes.POINTER(ctypes.c_int),
             ctypes.c_int,
         ]
+        lib.dmlc_pool_size.restype = ctypes.c_int
+        lib.dmlc_pool_size.argtypes = []
+        lib.dmlc_pool_shutdown.restype = None
+        lib.dmlc_pool_shutdown.argtypes = []
         _lib = lib
     except Exception as e:
         log.warning("native image pipeline unavailable (%s); using PIL", e)
@@ -96,11 +102,18 @@ def available() -> bool:
 
 
 def decode_resize_batch(
-    paths, size: int = 224, workers: int = 0
+    paths,
+    size: int = 224,
+    workers: int = 0,
+    out: np.ndarray | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Decode+resize JPEGs -> (uint8 [N, size, size, 3], status int32 [N]).
 
-    status[i] != 0 marks a failed decode (that slot is zeros). Raises
+    ``out``, when given, is a caller-owned reusable arena the batch decodes
+    into (C-contiguous uint8 [N, size, size, 3]) — repeated batches then
+    allocate nothing per call; None allocates fresh. status[i] != 0 marks a
+    failed decode (that slot is zeros). ``workers`` sizes the library's
+    persistent worker pool (grow-only; 0 = hardware concurrency). Raises
     RuntimeError if the native library is unavailable — callers that want
     the automatic PIL fallback go through ops.preprocess.load_batch.
     """
@@ -108,7 +121,16 @@ def decode_resize_batch(
     if lib is None:
         raise RuntimeError("native image pipeline not available")
     n = len(paths)
-    out = np.empty((n, size, size, 3), np.uint8)
+    shape = (n, size, size, 3)
+    if out is None:
+        out = np.empty(shape, np.uint8)
+    elif (
+        not isinstance(out, np.ndarray)
+        or out.shape != shape
+        or out.dtype != np.uint8
+        or not out.flags["C_CONTIGUOUS"]
+    ):
+        raise ValueError(f"out must be a C-contiguous uint8 array of shape {shape}")
     status = np.zeros(n, np.int32)
     if n == 0:
         return out, status
@@ -122,3 +144,18 @@ def decode_resize_batch(
         int(workers),
     )
     return out, status
+
+
+def pool_size() -> int:
+    """Worker count of the library's persistent decode pool (0 before the
+    first batch or when the library is absent)."""
+    lib = _load()
+    return int(lib.dmlc_pool_size()) if lib is not None else 0
+
+
+def pool_shutdown() -> None:
+    """Join the persistent pool's workers (no-op without the library).
+    Restartable: the next decode call re-grows the pool."""
+    lib = _load()
+    if lib is not None:
+        lib.dmlc_pool_shutdown()

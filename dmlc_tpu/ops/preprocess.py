@@ -20,16 +20,42 @@ from __future__ import annotations
 
 import concurrent.futures
 import os
+import threading
 from pathlib import Path
 from typing import Iterable, Sequence
 
 import jax.numpy as jnp
 import numpy as np
 
+from dmlc_tpu.utils.hotpath import hot_path
+
 IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
 IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
 CLIP_MEAN = np.array([0.48145466, 0.4578275, 0.40821073], np.float32)
 CLIP_STD = np.array([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+# ---- cached host decode pool ----------------------------------------------
+# One module-level pool shared by every load_batch call. The original design
+# built (and tore down) a fresh ThreadPoolExecutor per batch — at serving
+# steady state that is thread spawn/join churn on every shard, the exact
+# pattern lint rule H1 now forbids on hot paths. Grow-only: a bigger
+# ``workers`` request replaces the pool; the abandoned smaller pool's idle
+# threads are reclaimed at interpreter exit (same rationale as
+# JobScheduler._ensure_gang_pool).
+_HOST_POOL: concurrent.futures.ThreadPoolExecutor | None = None
+_HOST_POOL_WORKERS = 0
+_HOST_POOL_LOCK = threading.Lock()
+
+
+def _host_pool(workers: int) -> concurrent.futures.ThreadPoolExecutor:
+    global _HOST_POOL, _HOST_POOL_WORKERS
+    with _HOST_POOL_LOCK:
+        if _HOST_POOL is None or _HOST_POOL_WORKERS < workers:
+            _HOST_POOL = concurrent.futures.ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="pp-decode"
+            )
+            _HOST_POOL_WORKERS = workers
+        return _HOST_POOL
 
 
 def load_synset_words(path: str | Path) -> list[tuple[str, str]]:
@@ -71,33 +97,68 @@ def decode_resize(path: str | Path, size: int = 224) -> np.ndarray:
         return np.asarray(im, dtype=np.uint8)
 
 
+@hot_path
 def load_batch(
     paths: Sequence[str | Path],
     size: int = 224,
     workers: int | None = None,
     backend: str = "auto",
 ) -> np.ndarray:
-    """Decode+resize a batch -> uint8 [N, size, size, 3].
+    """Decode+resize a batch -> uint8 [N, size, size, 3] (fresh array).
+
+    Thin wrapper over :func:`load_batch_into`; callers that run batches in a
+    loop should preallocate the output once and use ``load_batch_into``
+    directly so steady-state decode allocates nothing per batch.
+    """
+    out = np.empty((len(paths), size, size, 3), np.uint8)
+    return load_batch_into(out, paths, size=size, workers=workers, backend=backend)
+
+
+@hot_path
+def load_batch_into(
+    out: np.ndarray,
+    paths: Sequence[str | Path],
+    size: int = 224,
+    workers: int | None = None,
+    backend: str = "auto",
+) -> np.ndarray:
+    """Decode+resize a batch into the caller-owned arena ``out`` (returned).
 
     This is the stage that must keep up with the TPU (SURVEY.md §7 hard part
-    b). ``backend``:
+    b). ``out`` must be C-contiguous uint8 [len(paths), size, size, 3]; both
+    the native and the PIL path fill it in place, so a caller that reuses one
+    buffer per pipeline slot pays zero allocations per batch. ``workers`` is
+    a concurrency hint — the cached pools (module-level here, persistent
+    in-library for native) grow to the largest ever requested and are never
+    rebuilt per call. ``backend``:
 
     - "native" — the C++ pipeline (dmlc_tpu.native): libjpeg with DCT-domain
-      downscaling + thread-pooled triangle resample, GIL-free.
-    - "pil" — PIL decode on a thread pool (decode releases the GIL).
+      downscaling + a persistent thread-pooled triangle resample, GIL-free.
+    - "pil" — PIL decode on the cached thread pool (decode releases the GIL).
     - "auto" — native when the library is built, else PIL. The two resize
       paths agree to within JPEG-noise tolerance (mean |diff| < 0.5/255 on
       the fixture corpus); a native decode failure falls back per-batch.
     """
-    if not paths:
-        return np.zeros((0, size, size, 3), np.uint8)
+    n = len(paths)
+    shape = (n, size, size, 3)
+    if (
+        not isinstance(out, np.ndarray)
+        or out.shape != shape
+        or out.dtype != np.uint8
+        or not out.flags["C_CONTIGUOUS"]
+    ):
+        raise ValueError(f"out must be a C-contiguous uint8 array of shape {shape}")
+    if not n:
+        return out
     if backend not in ("auto", "native", "pil"):
         raise ValueError(f"unknown backend {backend!r}")
     if backend in ("auto", "native"):
         from dmlc_tpu import native
 
         if native.available():
-            out, status = native.decode_resize_batch(paths, size, workers=workers or 0)
+            _, status = native.decode_resize_batch(
+                paths, size, workers=workers or 0, out=out
+            )
             if not status.any():
                 return out
             if backend == "native":
@@ -107,20 +168,53 @@ def load_batch(
         elif backend == "native":
             raise RuntimeError("native image pipeline not built")
     workers = workers or min(32, (os.cpu_count() or 8))
-    if len(paths) == 1 or workers == 1:
-        return np.stack([decode_resize(p, size) for p in paths])
-    with concurrent.futures.ThreadPoolExecutor(max_workers=workers) as pool:
-        return np.stack(list(pool.map(lambda p: decode_resize(p, size), paths)))
+    if n == 1 or workers == 1:
+        for i, p in enumerate(paths):
+            out[i] = decode_resize(p, size)
+        return out
+    pool = _host_pool(workers)
+
+    def fill(i: int) -> None:
+        out[i] = decode_resize(paths[i], size)
+
+    list(pool.map(fill, range(n)))  # list() re-raises worker exceptions
+    return out
+
+
+# Device-resident normalization constants, keyed by value: jnp.asarray on a
+# host constant is an upload (and a tracer-cache miss) — the standalone
+# normalize path was re-staging mean/std on EVERY call. The cache holds a
+# handful of 3-float arrays, so unbounded-by-key is bounded in practice.
+_DEVICE_CONSTS: dict[tuple, "jnp.ndarray"] = {}
+
+
+def _device_const(arr: np.ndarray):
+    arr = np.asarray(arr, np.float32)
+    key = (arr.tobytes(), arr.shape)
+    cached = _DEVICE_CONSTS.get(key)
+    if cached is None:
+        cached = _DEVICE_CONSTS[key] = jnp.asarray(arr)
+    return cached
 
 
 def normalize(batch_u8, mean: np.ndarray = IMAGENET_MEAN, std: np.ndarray = IMAGENET_STD):
     """Device-side: uint8 NHWC -> normalized float32 NHWC. Under jit, XLA fuses
-    this into the consumer; the Pallas variant exists for the standalone path."""
+    this into the consumer; the Pallas variant exists for the standalone path.
+    mean/std ride the device-constant cache, so repeated standalone calls
+    re-upload nothing."""
     x = jnp.asarray(batch_u8).astype(jnp.float32) / 255.0
-    return (x - jnp.asarray(mean)) / jnp.asarray(std)
+    return (x - _device_const(mean)) / _device_const(std)
 
 
 def stats_for_model(model_name: str) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side (numpy) normalization stats — always the same module-level
+    constant objects, never rebuilt, so callers may key caches on identity."""
     if model_name.startswith("clip"):
         return CLIP_MEAN, CLIP_STD
     return IMAGENET_MEAN, IMAGENET_STD
+
+
+def device_stats_for_model(model_name: str):
+    """Device-resident (jnp) normalization stats, cached across calls."""
+    mean, std = stats_for_model(model_name)
+    return _device_const(mean), _device_const(std)

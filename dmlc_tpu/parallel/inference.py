@@ -16,6 +16,10 @@ compile, ever) and the pad is masked out on the host side.
 
 from __future__ import annotations
 
+import collections
+import concurrent.futures
+import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Sequence
@@ -28,8 +32,38 @@ from jax.sharding import Mesh
 from dmlc_tpu.models import get_model
 from dmlc_tpu.ops import preprocess as pp
 from dmlc_tpu.parallel import mesh as mesh_lib
+from dmlc_tpu.utils.hotpath import hot_path
 from dmlc_tpu.utils.metrics import LatencyStats
 from dmlc_tpu.utils.tracing import tracer
+
+# ---- persistent decode-stage pool -----------------------------------------
+# Batch-granular decode tasks for run_paths_stream (each task itself fans
+# out per image through ops.preprocess's cached pool / the native library's
+# persistent pool). Module-level and lazily built ONCE — the old design
+# created a ThreadPoolExecutor(max_workers=1) inside every run_paths_stream
+# call, which both churned threads per shard and capped the decode stage at
+# one batch in flight. Width is small on purpose: the per-image fan-out
+# below it owns the cores; this pool only needs enough slots to keep
+# ``prefetch`` batches decoding concurrently.
+_STAGE_POOL: concurrent.futures.ThreadPoolExecutor | None = None
+_STAGE_POOL_LOCK = threading.Lock()
+
+
+def _stage_pool() -> concurrent.futures.ThreadPoolExecutor:
+    global _STAGE_POOL
+    with _STAGE_POOL_LOCK:
+        if _STAGE_POOL is None:
+            _STAGE_POOL = concurrent.futures.ThreadPoolExecutor(
+                max_workers=max(2, min(4, os.cpu_count() or 2)),
+                thread_name_prefix="ingest-decode",
+            )
+        return _STAGE_POOL
+
+
+#: Stage names exported by InferenceEngine.ingest_summary(), in pipeline
+#: order. "pipeline" records whole run_paths_stream walls, which is the
+#: denominator for per-stage occupancy.
+INGEST_STAGES = ("decode", "stage", "dispatch", "sync", "pipeline")
 
 
 @dataclass
@@ -157,6 +191,26 @@ class InferenceEngine:
                     "order — build the mesh with an unpermuted device list"
                 )
         self._forward = jax.jit(forward, in_shardings=(param_shd, data_shd), out_shardings=out_shd)
+        # Stream-pipeline variant: donates the staged input buffer so XLA may
+        # reuse its HBM while the pipeline stages the NEXT batch — the
+        # double-buffered staging ring (run_paths_stream) owns each buffer
+        # for exactly one dispatch. The shared _forward cannot donate: its
+        # callers (run_batch, bench) re-dispatch the same device arrays.
+        # CPU's PJRT backend doesn't implement donation (jax would warn on
+        # every batch), so there the stream path reuses the plain program.
+        if self.mesh.devices.flat[0].platform == "cpu":
+            self._forward_stream = self._forward
+        else:
+            self._forward_stream = jax.jit(
+                forward,
+                in_shardings=(param_shd, data_shd),
+                out_shardings=out_shd,
+                donate_argnums=(1,),
+            )
+        # Per-stage ingest pipeline counters (INGEST_STAGES): decode/stage/
+        # dispatch record from pool threads too, hence the lock.
+        self._ingest_lock = threading.Lock()
+        self._ingest = {k: LatencyStats() for k in INGEST_STAGES}
 
     @property
     def input_size(self) -> int:
@@ -276,28 +330,39 @@ class InferenceEngine:
             batch = pp.load_batch(paths, size=self.input_size, workers=workers)
         return self.run_batch(batch)
 
+    @hot_path
     def run_paths_stream(
         self, paths: Sequence[str], workers: int | None = None, prefetch: int = 2
     ) -> BatchResult:
-        """Decode overlapped with device compute (SURVEY §7 hard part b).
+        """Decode overlapped with h2d transfer and device compute (SURVEY §7
+        hard part b) — the three-stage ingest pipeline (docs/INGEST.md).
 
-        Pipeline: a background stage decodes batch i+1..i+prefetch (itself
-        fanning out across images via the native/PIL pool) while the device
-        runs batch i. Device calls are dispatched asynchronously and
-        materialized one batch behind, so at steady state the host decode,
-        host->HBM transfer, and device execution all overlap. Equivalent
-        results to calling ``run_paths`` per batch, at up to
+        1. **decode** — up to ``prefetch`` batches decode concurrently on the
+           persistent stage pool (each batch itself fanning out per image
+           via the native/PIL pool).
+        2. **stage** — a double-buffered staging ring moves decoded batches
+           onto the device (``jax.device_put`` with the batch sharding)
+           ahead of dispatch, so the host->HBM transfer of batch i+1 rides
+           under batch i's execution instead of inside its dispatch.
+        3. **dispatch/compute** — staged buffers feed the jitted forward
+           (input-donated off CPU, so the ring's HBM recycles), dispatched
+           asynchronously and materialized two batches behind.
+
+        Equivalent results to calling ``run_paths`` per batch, at up to
         min(decode_rate, device_rate) instead of their series combination.
+        Every stage records into ingest_summary()/the tracer so bench.py's
+        e2e leg can attribute wall time to decode vs stage vs compute vs
+        sync.
         """
-        import collections
-        import concurrent.futures
-
         if not paths:
             raise ValueError("empty path list")
         starts = list(range(0, len(paths), self.batch_size))
+        prefetch = max(1, int(prefetch))
+        pool = _stage_pool()
 
         def decode(s: int):
             chunk = paths[s : s + self.batch_size]
+            t0 = time.perf_counter()
             with tracer.span("host/decode", n=len(chunk)):
                 batch = pp.load_batch(chunk, size=self.input_size, workers=workers)
             if len(chunk) < self.batch_size:
@@ -305,28 +370,44 @@ class InferenceEngine:
                     (self.batch_size - len(chunk), *batch.shape[1:]), batch.dtype
                 )
                 batch = np.concatenate([batch, pad])
+            self._record_stage("decode", time.perf_counter() - t0, batch=len(chunk))
             return len(chunk), batch
 
         t_all = time.perf_counter()
         outs: list[tuple[int, Any]] = []
-        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as decoder:
-            futs = collections.deque(
-                decoder.submit(decode, s) for s in starts[:prefetch]
-            )
-            next_i = min(prefetch, len(starts))
-            inflight: collections.deque = collections.deque()
-            for _ in starts:
+        futs: collections.deque = collections.deque()
+        next_i = 0
+        while next_i < len(starts) and len(futs) < prefetch:
+            futs.append(pool.submit(decode, starts[next_i]))
+            next_i += 1
+        staged: collections.deque = collections.deque()
+        inflight: collections.deque = collections.deque()
+        for _ in starts:
+            # Fill the staging ring (depth 2): block on decode only when the
+            # ring is empty; opportunistically stage a second batch when its
+            # decode already finished, so the next dispatch finds its input
+            # device-resident.
+            while futs and len(staged) < 2 and (not staged or futs[0].done()):
                 n, batch = futs.popleft().result()
                 if next_i < len(starts):
-                    futs.append(decoder.submit(decode, starts[next_i]))
+                    futs.append(pool.submit(decode, starts[next_i]))
                     next_i += 1
-                out = self._forward(self.variables, batch)  # async dispatch
-                inflight.append((n, out))
-                if len(inflight) > 1:  # sync one batch behind
-                    outs.append(self._materialize(*inflight.popleft()))
-            while inflight:
+                t0 = time.perf_counter()
+                buf = jax.device_put(batch, self._data_sharding)
+                self._record_stage("stage", time.perf_counter() - t0, batch=int(n))
+                staged.append((n, buf))
+            n, buf = staged.popleft()
+            t0 = time.perf_counter()
+            out = self._forward_stream(self.variables, buf)  # async dispatch
+            self._record_stage("dispatch", time.perf_counter() - t0, batch=int(n))
+            inflight.append((n, out))
+            if len(inflight) > 2:  # sync two batches behind
                 outs.append(self._materialize(*inflight.popleft()))
+        while inflight:
+            outs.append(self._materialize(*inflight.popleft()))
         total_dt = time.perf_counter() - t_all
+        with self._ingest_lock:
+            self._ingest["pipeline"].record(total_dt)
 
         if self.spec.classifier:
             idx = np.concatenate([np.asarray(o[0])[:n] for n, o in outs])
@@ -346,10 +427,46 @@ class InferenceEngine:
         true per-batch device latency into latency_summary.)"""
         t0 = time.perf_counter()
         out = jax.block_until_ready(out)
-        tracer.record(
-            "device/sync_wait", time.perf_counter() - t0, model=self.spec.name, batch=int(n)
-        )
+        dt = time.perf_counter() - t0
+        with self._ingest_lock:
+            self._ingest["sync"].record(dt)
+        tracer.record("device/sync_wait", dt, model=self.spec.name, batch=int(n))
         return n, out
+
+    # ---- ingest pipeline observability ---------------------------------
+
+    def _record_stage(self, stage: str, dt: float, **attrs) -> None:
+        with self._ingest_lock:
+            self._ingest[stage].record(dt)
+        tracer.record(f"ingest/{stage}", dt, model=self.spec.name, **attrs)
+
+    def ingest_summary(self) -> dict[str, dict[str, float]]:
+        """Per-stage pipeline counters since construction (or the last
+        reset): count, total busy seconds, mean, and occupancy — the stage's
+        busy time over the summed run_paths_stream wall time, i.e. how much
+        of the pipeline's life the stage spent working. The bottleneck stage
+        reads near 1.0; in a well-overlapped pipeline the others still show
+        substantial occupancy instead of summing to 1.0 (that sum-to-one
+        shape is the serial-pipeline signature)."""
+        with self._ingest_lock:
+            wall = self._ingest["pipeline"]
+            wall_total = wall.mean * wall.n if wall.n else 0.0
+            out: dict[str, dict[str, float]] = {}
+            for name, st in self._ingest.items():
+                total = st.mean * st.n if st.n else 0.0
+                entry = {
+                    "count": float(st.n),
+                    "total_s": total,
+                    "mean_s": st.mean if st.n else 0.0,
+                }
+                if name != "pipeline":
+                    entry["occupancy"] = total / wall_total if wall_total > 0 else 0.0
+                out[name] = entry
+            return out
+
+    def reset_ingest_stats(self) -> None:
+        with self._ingest_lock:
+            self._ingest = {k: LatencyStats() for k in INGEST_STAGES}
 
     def latency_summary(self) -> dict[str, float]:
         return self._stats.summary()

@@ -14,18 +14,159 @@ logic is testable with no JAX at all.
 
 from __future__ import annotations
 
+import concurrent.futures
 import logging
 import threading
+import time
 from collections import OrderedDict
 from pathlib import Path
 from typing import Callable, Protocol, Sequence
 
 from dmlc_tpu.cluster.rpc import RpcError
+from dmlc_tpu.utils.hotpath import hot_path
+from dmlc_tpu.utils.metrics import LatencyStats
+from dmlc_tpu.utils.tracing import tracer
 
 log = logging.getLogger(__name__)
 
 # (synset_ids) -> list of predicted class indices
 PredictFn = Callable[[Sequence[str]], list[int]]
+
+
+class DynamicBatcher:
+    """Dynamic micro-batcher: coalesce concurrent small classify requests
+    into device-shaped batches.
+
+    The engine's unit of work is a ``batch_size`` XLA execution; an RPC
+    carrying one (or a few) synsets would otherwise pay a whole padded
+    device dispatch for itself. This wrapper queues incoming requests and a
+    background worker drains them in batches: a batch dispatches the moment
+    ``batch_size`` items are queued, or when the OLDEST queued item has
+    waited ``max_wait_s`` — so under load N single-image requests ride
+    ceil(N / batch_size) device dispatches, while a lone request is delayed
+    at most the deadline. Results map back to their callers by queue order
+    (the wrapped ``predict`` returns predictions in argument order).
+
+    Wraps any PredictFn-shaped backend: ``__call__`` is the batched predict
+    surface, and every other attribute (``warmup``, ``load_variables``,
+    ``predict_gang``, ...) passes through to the wrapped backend — gang
+    shards are collective SPMD executions whose slicing must not be
+    reordered, so they deliberately bypass the batcher.
+    """
+
+    def __init__(
+        self,
+        predict: PredictFn,
+        batch_size: int,
+        max_wait_s: float = 0.005,
+        name: str = "microbatch",
+    ):
+        # _predict is set FIRST: __getattr__ delegates to it, and any
+        # attribute probe before it exists would recurse.
+        self._predict = predict
+        self.batch_size = int(batch_size)
+        self.max_wait_s = float(max_wait_s)
+        if self.batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        # One Condition owns all batcher state; its internal lock is only
+        # ever held for list surgery — the device dispatch runs outside it.
+        self._cv = threading.Condition()
+        self._queue: list[tuple[str, concurrent.futures.Future]] = []
+        self._closed = False
+        self.requests = 0    # items ever submitted
+        self.dispatches = 0  # device-shaped batches sent to the backend
+        self.fill = LatencyStats()  # per-dispatch batch fill fraction
+        self._thread = threading.Thread(target=self._loop, name=name, daemon=True)
+        self._thread.start()
+
+    # ---- request side ---------------------------------------------------
+
+    def submit(self, synset: str) -> "concurrent.futures.Future":
+        """Queue one classify request; the future resolves to its predicted
+        class index once the batch it rides in completes."""
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("batcher is stopped")
+            self._queue.append((synset, fut))
+            self.requests += 1
+            self._cv.notify_all()
+        return fut
+
+    @hot_path
+    def __call__(self, synsets: Sequence[str]) -> list[int]:
+        """PredictFn surface: queue every synset, wait for all results.
+        Items from concurrent callers interleave into shared batches, which
+        is the whole point; per-caller order is preserved by the futures."""
+        futs = [self.submit(s) for s in synsets]
+        return [int(f.result()) for f in futs]
+
+    def __getattr__(self, name: str):
+        # Backend capability passthrough (warmup/load_variables/decode_gang/
+        # predict_gang/image_source/...). Only called for attributes not
+        # found on the batcher itself.
+        return getattr(self._predict, name)
+
+    # ---- worker side ----------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if not self._queue and self._closed:
+                    return
+                # Deadline semantics: measured from the moment the worker
+                # sees the first queued item; the batch goes as soon as it
+                # is FULL, else when the deadline lapses (partial batch).
+                deadline = time.monotonic() + self.max_wait_s
+                while len(self._queue) < self.batch_size and not self._closed:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._cv.wait(timeout=left)
+                batch = self._queue[: self.batch_size]
+                del self._queue[: self.batch_size]
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: list) -> None:
+        synsets = [s for s, _ in batch]
+        try:
+            with tracer.span("scheduler/microbatch", n=len(synsets)):
+                preds = list(self._predict(synsets))
+            if len(preds) != len(synsets):
+                raise RpcError(
+                    f"backend returned {len(preds)} predictions for "
+                    f"{len(synsets)} queries"
+                )
+        except BaseException as e:  # noqa: BLE001 - every waiter must observe the failure
+            for _, fut in batch:
+                fut.set_exception(e)
+            return
+        with self._cv:
+            self.dispatches += 1
+            self.fill.record(len(batch) / self.batch_size)
+        for (_, fut), pred in zip(batch, preds):
+            fut.set_result(int(pred))
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        """Drain the queue (queued requests still complete), then join the
+        worker. Further submits raise."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=timeout_s)
+
+    def summary(self) -> dict:
+        """Coalescing counters for reports/bench: requests, device
+        dispatches, and the mean batch-fill fraction (1.0 = every dispatch
+        rode a full device batch)."""
+        with self._cv:
+            return {
+                "requests": self.requests,
+                "dispatches": self.dispatches,
+                "mean_fill": self.fill.mean if len(self.fill) else 0.0,
+            }
 
 
 def _resolve_paths(image_source, data_dir: Path, synsets: Sequence[str]) -> list[Path]:
@@ -308,6 +449,12 @@ class ExportedBackend:
         self.image_source = image_source
         self._server = None
         self._lock = threading.Lock()
+        # Persistent decode-ahead worker for the shard pipeline below —
+        # created once here, never per shard (lint H1: no per-call pools on
+        # hot paths; the old code built a ThreadPoolExecutor every __call__).
+        self._decoder = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="export-decode"
+        )
 
     def warmup(self) -> None:
         with self._lock:
@@ -360,9 +507,8 @@ class ExportedBackend:
             self._input_size = int(u8_aval.shape[1])
         return self._server
 
+    @hot_path
     def __call__(self, synsets: Sequence[str]) -> list[int]:
-        import concurrent.futures
-
         from dmlc_tpu.ops import preprocess as pp
 
         if not synsets:
@@ -374,19 +520,19 @@ class ExportedBackend:
             starts = list(range(0, len(paths), chunk_size))
             preds: list[int] = []
             # Decode chunk i+1 while the artifact executes chunk i (the same
-            # overlap EngineBackend gets from run_paths_stream).
-            with concurrent.futures.ThreadPoolExecutor(max_workers=1) as decoder:
-                decode = lambda s: pp.load_batch(
-                    paths[s : s + chunk_size], size=self._input_size
-                )
-                fut = decoder.submit(decode, starts[0])
-                for i, s in enumerate(starts):
-                    # dmlc-lint: disable=L1 -- the backend lock serializes shards per artifact by design (reference's model mutex); the wait is the decode/execute pipeline inside one shard
-                    batch = fut.result()
-                    if i + 1 < len(starts):
-                        fut = decoder.submit(decode, starts[i + 1])
-                    idx, _ = server(batch)
-                    preds.extend(int(x) for x in idx)
+            # overlap EngineBackend gets from run_paths_stream), on the
+            # PERSISTENT self._decoder — never a per-shard pool (lint H1).
+            decode = lambda s: pp.load_batch(
+                paths[s : s + chunk_size], size=self._input_size
+            )
+            fut = self._decoder.submit(decode, starts[0])
+            for i, s in enumerate(starts):
+                # dmlc-lint: disable=L1 -- the backend lock serializes shards per artifact by design (reference's model mutex); the wait is the decode/execute pipeline inside one shard
+                batch = fut.result()
+                if i + 1 < len(starts):
+                    fut = self._decoder.submit(decode, starts[i + 1])
+                idx, _ = server(batch)
+                preds.extend(int(x) for x in idx)
             return preds
 
     def load_variables(self, variables) -> None:

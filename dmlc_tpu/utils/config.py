@@ -71,6 +71,14 @@ class ClusterConfig:
     # fresh work runs out (tail hedging; dedup makes it exactly-once).
     hedge_tail: bool = True
 
+    # --- dynamic request micro-batching (scheduler/worker.DynamicBatcher) ---
+    # Coalesce concurrent small `job.predict` requests into device-shaped
+    # batches: a request waits at most this long for peers before its batch
+    # dispatches (batch fills dispatch immediately). 0 disables — each RPC
+    # keeps its own engine call, the pre-batcher behavior. Gang (collective)
+    # shards always bypass the batcher.
+    microbatch_wait_s: float = 0.0
+
     # --- inference engine ---
     # Chips on this host, for the leader's capacity-weighted shard
     # placement (north star: "per-host chip topology ... ICI-local

@@ -13,6 +13,11 @@
 // filter support widens by the downscale ratio, so it is a proper
 // antialiasing resample, not naive point-sampled bilerp) — keeps accuracy
 // parity with the Python/PIL path.
+// Threading: one PERSISTENT worker pool shared by every call (see DecodePool
+// below). The original design spawned and joined fresh std::threads per
+// dmlc_decode_resize_batch call, which at serving steady state (one call per
+// shard, many shards per second) paid thread churn and a fresh decode
+// scratch allocation on every batch.
 //
 // C ABI only; Python binds with ctypes (no pybind11 in this image).
 
@@ -24,10 +29,13 @@
 #include <algorithm>
 #include <atomic>
 #include <cmath>
+#include <condition_variable>
 #include <csetjmp>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <deque>
+#include <mutex>
 #include <thread>
 #include <vector>
 
@@ -197,45 +205,187 @@ void resize_triangle(const uint8_t* src, int w, int h, int out, uint8_t* dst) {
   }
 }
 
+// ---- persistent decode pool ------------------------------------------------
+//
+// A batch call publishes one BatchJob; pool workers (and the submitting
+// thread itself) claim item indices via fetch_add and decode into the
+// caller's output arena. The submitter blocks until every claimed item is
+// finished AND no worker is still inside the job (the `active` count —
+// without it a worker between claiming nothing and returning could touch
+// the stack-allocated job after the submitter destroyed it). Worker decode
+// scratch (`rgb`) lives for the thread's lifetime, so steady-state batches
+// allocate nothing per image beyond libjpeg internals.
+
+struct BatchJob {
+  const char** paths = nullptr;
+  int n = 0;
+  int size = 0;
+  uint8_t* out = nullptr;
+  int* status = nullptr;
+  std::atomic<int> next{0};  // item claim cursor
+  int done = 0;              // finished items   (guarded by DecodePool::mu_)
+  int failures = 0;          // failed decodes   (guarded by DecodePool::mu_)
+  int active = 0;            // workers inside the job (guarded by mu_)
+  std::condition_variable done_cv;
+};
+
+class DecodePool {
+ public:
+  static DecodePool& instance() {
+    // Deliberately leaked: a static destructor would tear the mutex/cv down
+    // under workers still blocked in wait() at process exit. Reachable via
+    // this pointer, so LeakSanitizer stays quiet; dmlc_pool_shutdown() is
+    // the orderly teardown for harnesses that want one.
+    static DecodePool* pool = new DecodePool();
+    return *pool;
+  }
+
+  int run(const char** paths, int n, int size, uint8_t* out, int* status,
+          int n_threads) {
+    ensure(n_threads);
+    BatchJob job;
+    job.paths = paths;
+    job.n = n;
+    job.size = size;
+    job.out = out;
+    job.status = status;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      jobs_.push_back(&job);
+    }
+    cv_.notify_all();
+    // The submitting thread works the job too: a pool busy with another
+    // batch (or shut down) degenerates to the old inline decode instead of
+    // sleeping on the queue.
+    std::vector<uint8_t> scratch;
+    int finished = 0, failed = 0;
+    work(&job, scratch, finished, failed);
+    std::unique_lock<std::mutex> lk(mu_);
+    job.done += finished;
+    job.failures += failed;
+    job.done_cv.wait(lk, [&] { return job.done >= job.n && job.active == 0; });
+    // If no worker ever popped it (fully drained by the submitter), the
+    // exhausted job may still sit in the queue; remove before it goes out
+    // of scope.
+    for (auto it = jobs_.begin(); it != jobs_.end(); ++it) {
+      if (*it == &job) {
+        jobs_.erase(it);
+        break;
+      }
+    }
+    return job.failures;
+  }
+
+  // Grow-only sizing: batches of different sizes share one pool, and
+  // shrinking for a small call would reintroduce exactly the thread churn
+  // this pool exists to end. n_threads <= 0 asks for hardware_concurrency.
+  void ensure(int n_threads) {
+    size_t want = n_threads > 0
+                      ? (size_t)n_threads
+                      : (size_t)std::max(1u, std::thread::hardware_concurrency());
+    want = std::min(want, (size_t)64);
+    std::lock_guard<std::mutex> lk(mu_);
+    if (stopping_) return;  // mid-shutdown callers run inline via run()
+    while (workers_.size() < want)
+      workers_.emplace_back([this] { worker_loop(); });
+  }
+
+  int size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return (int)workers_.size();
+  }
+
+  // Join every worker. Restartable: the next ensure() re-grows the pool.
+  void shutdown() {
+    std::vector<std::thread> doomed;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stopping_ = true;
+      doomed.swap(workers_);
+    }
+    cv_.notify_all();
+    for (auto& t : doomed) t.join();
+    std::lock_guard<std::mutex> lk(mu_);
+    stopping_ = false;
+  }
+
+ private:
+  void worker_loop() {
+    std::vector<uint8_t> scratch;  // reused for every image this thread decodes
+    std::unique_lock<std::mutex> lk(mu_);
+    for (;;) {
+      cv_.wait(lk, [&] { return stopping_ || !jobs_.empty(); });
+      if (stopping_) return;
+      BatchJob* job = jobs_.front();
+      if (job->next.load(std::memory_order_relaxed) >= job->n) {
+        // Fully claimed: out of the queue; stragglers finish via `active`.
+        jobs_.pop_front();
+        continue;
+      }
+      ++job->active;
+      lk.unlock();
+      int finished = 0, failed = 0;
+      work(job, scratch, finished, failed);
+      lk.lock();
+      --job->active;
+      job->done += finished;
+      job->failures += failed;
+      if (job->done >= job->n && job->active == 0) job->done_cv.notify_all();
+    }
+  }
+
+  // Claim and decode items until the job's cursor is exhausted.
+  static void work(BatchJob* job, std::vector<uint8_t>& scratch,
+                   int& finished, int& failed) {
+    const size_t stride = (size_t)job->size * job->size * 3;
+    for (;;) {
+      int i = job->next.fetch_add(1);
+      if (i >= job->n) return;
+      int w = 0, h = 0;
+      if (decode_jpeg(job->paths[i], job->size, scratch, w, h)) {
+        resize_triangle(scratch.data(), w, h, job->size,
+                        job->out + stride * i);
+        job->status[i] = 0;
+      } else {
+        std::memset(job->out + stride * i, 0, stride);
+        job->status[i] = 1;
+        ++failed;
+      }
+      ++finished;
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<BatchJob*> jobs_;
+  std::vector<std::thread> workers_;
+  bool stopping_ = false;
+};
+
 }  // namespace
 
 extern "C" {
 
-// Decode + resize a batch of JPEG files into out[n, size, size, 3] uint8.
-// paths: n C strings. status[i]: 0 ok, 1 decode failure.
-// n_threads <= 0 means hardware_concurrency. Returns count of failures.
+// Decode + resize a batch of JPEG files into out[n, size, size, 3] uint8 —
+// the caller-owned output arena (numpy buffers on the Python side, reused
+// across batches). paths: n C strings. status[i]: 0 ok, 1 decode failure.
+// n_threads sizes the persistent pool (grow-only; <= 0 means
+// hardware_concurrency). Returns count of failures.
 int dmlc_decode_resize_batch(const char** paths, int n, int size,
                              uint8_t* out, int* status, int n_threads) {
   if (n <= 0) return 0;
-  if (n_threads <= 0) n_threads = (int)std::thread::hardware_concurrency();
-  n_threads = std::max(1, std::min(n_threads, n));
-  std::atomic<int> next(0);
-  std::atomic<int> failures(0);
-  size_t stride = (size_t)size * size * 3;
-
-  auto work = [&]() {
-    std::vector<uint8_t> rgb;
-    for (;;) {
-      int i = next.fetch_add(1);
-      if (i >= n) return;
-      int w = 0, h = 0;
-      if (decode_jpeg(paths[i], size, rgb, w, h)) {
-        resize_triangle(rgb.data(), w, h, size, out + stride * i);
-        status[i] = 0;
-      } else {
-        std::memset(out + stride * i, 0, stride);
-        status[i] = 1;
-        failures.fetch_add(1);
-      }
-    }
-  };
-  std::vector<std::thread> threads;
-  for (int t = 0; t < n_threads; ++t) threads.emplace_back(work);
-  for (auto& th : threads) th.join();
-  return failures.load();
+  return DecodePool::instance().run(paths, n, size, out, status, n_threads);
 }
 
+// Current persistent-pool worker count (0 before the first batch / after
+// shutdown) — observability for tests and the Python binding.
+int dmlc_pool_size() { return DecodePool::instance().size(); }
+
+// Join the pool's workers (restartable: the next batch call re-grows it).
+// Called by the sanitizer harness so teardown runs under TSan/ASan too.
+void dmlc_pool_shutdown() { DecodePool::instance().shutdown(); }
+
 // Version tag so Python can detect stale builds.
-int dmlc_native_abi_version() { return 1; }
+int dmlc_native_abi_version() { return 2; }
 
 }  // extern "C"

@@ -1,21 +1,29 @@
 // Sanitizer harness for the native image pipeline (SURVEY §5: the rebuild
 // must recover, via TSan/ASan, the memory/race safety the reference got for
-// free from Rust). Drives dmlc_decode_resize_batch across threads, repeating
+// free from Rust). Drives dmlc_decode_resize_batch through the PERSISTENT
+// decode pool from two concurrent submitter threads — the steady-state
+// serving shape (stream prefetch + RPC shards share one pool) — repeating
 // the argv path list (which deliberately includes corrupt files so the
-// libjpeg longjmp error path runs under the sanitizer too). Exit code 0 =
-// no sanitizer report; decode failures are expected and NOT errors.
+// libjpeg longjmp error path runs under the sanitizer too). The pool is then
+// shut down and restarted for one more round so teardown/regrow runs under
+// the sanitizer as well. Exit code 0 = no sanitizer report; decode failures
+// are expected and NOT errors.
 //
 // Built by `make sanitize` as two binaries: sanitize_asan
 // (-fsanitize=address,undefined + LeakSanitizer) and sanitize_tsan
 // (-fsanitize=thread). Driven by tests/test_native_sanitize.py.
 
+#include <atomic>
 #include <cstdint>
 #include <cstdio>
+#include <thread>
 #include <vector>
 
 extern "C" int dmlc_decode_resize_batch(const char** paths, int n, int size,
                                         uint8_t* out, int* status,
                                         int n_threads);
+extern "C" void dmlc_pool_shutdown();
+extern "C" int dmlc_pool_size();
 
 int main(int argc, char** argv) {
   if (argc < 2) {
@@ -28,14 +36,38 @@ int main(int argc, char** argv) {
   for (int r = 0; r < repeats; ++r)
     for (int i = 1; i < argc; ++i) paths.push_back(argv[i]);
   int n = (int)paths.size();
-  std::vector<uint8_t> out((size_t)n * size * size * 3);
-  std::vector<int> status(n);
-  int total_failures = 0;
+  std::atomic<int> total_failures(0);
+
+  auto submit = [&](uint8_t* out, int* status) {
+    total_failures.fetch_add(
+        dmlc_decode_resize_batch(paths.data(), n, size, out, status, 4));
+  };
+
+  // Two caller-owned output arenas, reused across every round below — the
+  // same buffer-recycling contract the Python binding's out= parameter has.
+  std::vector<uint8_t> out_a((size_t)n * size * size * 3);
+  std::vector<uint8_t> out_b((size_t)n * size * size * 3);
+  std::vector<int> status_a(n), status_b(n);
+  int rounds = 0;
   for (int round = 0; round < 3; ++round) {
-    total_failures += dmlc_decode_resize_batch(paths.data(), n, size,
-                                               out.data(), status.data(), 4);
+    std::thread a([&] { submit(out_a.data(), status_a.data()); });
+    std::thread b([&] { submit(out_b.data(), status_b.data()); });
+    a.join();
+    b.join();
+    rounds += 2;
   }
-  std::printf("decoded %d items x3 rounds, %d failures (corrupt inputs expected)\n",
-              n, total_failures);
+  // Orderly teardown under the sanitizer, then one restart round: the next
+  // batch call must regrow the pool transparently.
+  dmlc_pool_shutdown();
+  if (dmlc_pool_size() != 0) {
+    std::fprintf(stderr, "pool not empty after shutdown\n");
+    return 3;
+  }
+  submit(out_a.data(), status_a.data());
+  ++rounds;
+  dmlc_pool_shutdown();
+  std::printf(
+      "decoded %d items x%d rounds, %d failures (corrupt inputs expected)\n",
+      n, rounds, total_failures.load());
   return 0;
 }

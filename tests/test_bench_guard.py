@@ -460,6 +460,37 @@ class TestE2eGuard:
     def test_none_passthrough(self):
         assert bench.annotate_e2e(None, self.OLD) is None
 
+    STAGES = {"decode": 1.21, "stage": 0.34, "dispatch": 0.05, "sync": 0.41}
+
+    def test_stage_seconds_ride_through_annotate_and_merge(self):
+        # The per-stage breakdown (PR 2 ingest metrics) is diagnostic data,
+        # not a guarded rate leg: it must pass annotate_e2e untouched and
+        # merge fresh-over-old like any field.
+        new = bench.annotate_e2e(
+            {"model": "resnet18", "e2e_img_s": 120.0, "serial_img_s": 85.0,
+             "stage_seconds": dict(self.STAGES)},
+            self.OLD,
+        )
+        assert new["stage_seconds"] == self.STAGES
+        assert "degraded_vs_history" not in new
+        old = dict(self.OLD, stage_seconds={"decode": 9.0})
+        merged = bench.merge_detail({"configs": [], "e2e": new},
+                                    {"configs": [], "e2e": old})
+        assert merged["e2e"]["stage_seconds"] == self.STAGES
+        assert "stale" not in merged["e2e"]
+
+    def test_stage_seconds_none_falls_back_stale(self):
+        # A deadline-truncated run (stream leg skipped -> stage_seconds
+        # None) keeps the previous breakdown, stamped stale like any
+        # truncated field.
+        old = dict(self.OLD, stage_seconds=dict(self.STAGES))
+        new = {"model": "resnet18", "e2e_img_s": 118.0, "serial_img_s": 84.0,
+               "stage_seconds": None}
+        merged = bench.merge_detail({"configs": [], "e2e": new},
+                                    {"configs": [], "e2e": old})
+        assert merged["e2e"]["stage_seconds"] == self.STAGES
+        assert merged["e2e"]["stale"] is True
+
     def test_model_change_judged_fresh(self):
         # A promoted-headline model (legitimately slower) must not be
         # flagged against the previous model's rates, nor inherit its

@@ -1,0 +1,270 @@
+"""Ingest-path overhaul pins: persistent pools, reusable output arenas,
+double-buffered staging, per-stage metrics, and the acceptance bar that the
+three pipeline stages genuinely overlap.
+
+The overlap test is hermetic and deterministic-by-construction: decode and
+compute are each dominated by a ``time.sleep`` (which releases the GIL, so
+the stages CAN overlap even on this 1-core CI host), and the assertion
+compares the pipeline's e2e wall against the measured decode-only and
+compute-only legs — e2e must land within 1.15x of the slower leg, i.e. the
+faster stage rides under the slower one instead of adding to it.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.ops import preprocess as pp
+from dmlc_tpu.utils import corpus
+from tiny_model import N_CLASSES  # noqa: F401  (registers "tinynet")
+
+
+@pytest.fixture(scope="module")
+def corpus_paths(tmp_path_factory):
+    root = tmp_path_factory.mktemp("ingest_corpus")
+    data_dir, _ = corpus.generate(root, n_classes=16, images_per_class=4, size=48)
+    paths = sorted(p for d in sorted(data_dir.iterdir()) for p in d.iterdir())
+    assert len(paths) == 64  # 8 batches of 8
+    return paths
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the stages demonstrably overlap
+# ---------------------------------------------------------------------------
+
+
+def test_stream_pipeline_overlaps_stages(corpus_paths, monkeypatch):
+    """e2e wall <= 1.15 x max(decode-only, compute-only) over 8 batches."""
+    import jax
+
+    from dmlc_tpu.parallel.inference import InferenceEngine
+
+    engine = InferenceEngine("tinynet", batch_size=8, seed=5)
+    engine.warmup()
+    paths = corpus_paths  # 8 batches
+
+    # Compute-bound on purpose, with a clear gap: e2e pays one pipeline-fill
+    # decode (DECODE_S) on top of the compute-bound steady state, so the
+    # decode:compute ratio sets the test's noise margin under the 1.15x bar.
+    DECODE_S = 0.04   # per-batch decode cost (sleeps release the GIL)
+    COMPUTE_S = 0.10  # per-batch device cost
+
+    real_load = pp.load_batch
+
+    def slow_load(ps, **kw):
+        time.sleep(DECODE_S)
+        return real_load(ps, **kw)
+
+    real_fwd = engine._forward_stream
+
+    def slow_fwd(variables, u8):
+        out = real_fwd(variables, u8)
+        time.sleep(COMPUTE_S)
+        return out
+
+    monkeypatch.setattr(pp, "load_batch", slow_load)
+    engine._forward_stream = slow_fwd
+
+    # Decode-only leg: every batch through the (slowed) decode stage, serial.
+    n_batches = -(-len(paths) // engine.batch_size)
+    t0 = time.perf_counter()
+    batches = []
+    for s in range(0, len(paths), engine.batch_size):
+        batches.append(slow_load(paths[s : s + engine.batch_size], size=engine.input_size))
+    decode_only = time.perf_counter() - t0
+
+    # Compute-only leg: every (pre-decoded) batch through the slowed
+    # forward, synced per batch.
+    t0 = time.perf_counter()
+    for b in batches:
+        jax.block_until_ready(slow_fwd(engine.variables, b))
+    compute_only = time.perf_counter() - t0
+
+    # The pipeline itself.
+    t0 = time.perf_counter()
+    result = engine.run_paths_stream(paths)
+    e2e = time.perf_counter() - t0
+
+    assert len(result.top1_index) == len(paths)
+    slower = max(decode_only, compute_only)
+    assert e2e <= 1.15 * slower, (
+        f"pipeline did not overlap: e2e {e2e:.3f}s vs decode-only "
+        f"{decode_only:.3f}s / compute-only {compute_only:.3f}s "
+        f"({n_batches} batches)"
+    )
+    # And far below the serial sum — the old decode-then-compute shape.
+    assert e2e <= 0.85 * (decode_only + compute_only)
+
+
+# ---------------------------------------------------------------------------
+# per-stage metrics
+# ---------------------------------------------------------------------------
+
+
+def test_ingest_metrics_attribute_stages(corpus_paths):
+    from dmlc_tpu.parallel.inference import INGEST_STAGES, InferenceEngine
+
+    engine = InferenceEngine("tinynet", batch_size=8, seed=6)
+    engine.run_paths_stream(corpus_paths[:17])  # 3 batches (ragged tail)
+    s = engine.ingest_summary()
+    assert set(s) == set(INGEST_STAGES)
+    for stage in ("decode", "stage", "dispatch", "sync"):
+        assert s[stage]["count"] == 3, stage
+        assert s[stage]["total_s"] >= 0.0
+        assert "occupancy" in s[stage]
+    assert s["pipeline"]["count"] == 1
+    # Occupancy is per-stage busy time over pipeline wall: bounded sanity.
+    assert 0.0 < s["decode"]["occupancy"] <= 1.5
+    engine.reset_ingest_stats()
+    assert engine.ingest_summary()["decode"]["count"] == 0
+
+
+def test_stream_partial_final_batch_padding(corpus_paths):
+    """Direct pin on the tail-batch path: corpus sizes that are NOT a
+    multiple of batch_size (including < one batch) are padded to the one
+    compiled shape and truncated in the result — classifier branch."""
+    from dmlc_tpu.parallel.inference import InferenceEngine
+
+    engine = InferenceEngine("tinynet", batch_size=8, seed=7)
+    for n in (3, 9, 23):
+        subset = corpus_paths[:n]
+        stream = engine.run_paths_stream(subset)
+        assert stream.top1_index.shape == (n,)
+        assert stream.top1_prob.shape == (n,)
+        serial_idx = []
+        for s in range(0, n, 8):
+            serial_idx.extend(engine.run_paths(subset[s : s + 8]).top1_index)
+        np.testing.assert_array_equal(stream.top1_index, serial_idx)
+
+
+def test_stream_partial_final_batch_embedding(corpus_paths):
+    """Same tail-batch pin for the embedding (non-classifier) branch."""
+    from tiny_model import TinyEmbed  # noqa: F401  (registers tinyembed)
+
+    from dmlc_tpu.parallel.inference import InferenceEngine
+
+    engine = InferenceEngine("tinyembed", batch_size=8, seed=8)
+    for n in (5, 11):
+        stream = engine.run_paths_stream(corpus_paths[:n])
+        assert stream.embeddings.shape[0] == n
+    serial = engine.run_paths(corpus_paths[:5])
+    stream = engine.run_paths_stream(corpus_paths[:5])
+    np.testing.assert_allclose(stream.embeddings, serial.embeddings, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# persistent pools + caller-owned arenas
+# ---------------------------------------------------------------------------
+
+
+def test_host_pool_is_cached_and_grow_only():
+    a = pp._host_pool(2)
+    assert pp._host_pool(2) is a
+    assert pp._host_pool(1) is a  # smaller request reuses the bigger pool
+    b = pp._host_pool(max(pp._HOST_POOL_WORKERS + 1, 3))
+    assert pp._host_pool(2) is b  # grown pool replaces, then sticks
+
+
+def test_stage_pool_is_persistent():
+    from dmlc_tpu.parallel import inference
+
+    assert inference._stage_pool() is inference._stage_pool()
+
+
+def test_load_batch_into_fills_caller_arena(corpus_paths):
+    n, size = 6, 48
+    arena = np.zeros((n, size, size, 3), np.uint8)
+    got = pp.load_batch_into(arena, corpus_paths[:n], size=size)
+    assert got is arena
+    fresh = pp.load_batch(corpus_paths[:n], size=size)
+    np.testing.assert_array_equal(arena, fresh)
+    # Reuse the SAME arena for a different batch: fully overwritten.
+    pp.load_batch_into(arena, corpus_paths[n : 2 * n], size=size)
+    fresh2 = pp.load_batch(corpus_paths[n : 2 * n], size=size)
+    np.testing.assert_array_equal(arena, fresh2)
+
+
+def test_load_batch_into_validates_arena(corpus_paths):
+    with pytest.raises(ValueError, match="C-contiguous uint8"):
+        pp.load_batch_into(np.zeros((2, 48, 48, 3), np.float32), corpus_paths[:2], size=48)
+    with pytest.raises(ValueError, match="C-contiguous uint8"):
+        pp.load_batch_into(np.zeros((3, 48, 48, 3), np.uint8), corpus_paths[:2], size=48)
+
+
+def test_native_pool_persists_and_accepts_arena(corpus_paths):
+    from dmlc_tpu import native
+
+    if not native.available():
+        pytest.skip("native pipeline not built")
+    n, size = 4, 48
+    out1, status = native.decode_resize_batch(corpus_paths[:n], size)
+    assert not status.any()
+    workers = native.pool_size()
+    assert workers > 0  # persistent pool is live after the first batch
+    arena = np.empty((n, size, size, 3), np.uint8)
+    out2, status = native.decode_resize_batch(corpus_paths[:n], size, out=arena)
+    assert out2 is arena and not status.any()
+    np.testing.assert_array_equal(out1, arena)
+    assert native.pool_size() == workers  # no churn across calls
+    with pytest.raises(ValueError, match="C-contiguous"):
+        native.decode_resize_batch(corpus_paths[:n], size, out=np.empty((n, size, size, 3), np.int16))
+
+
+def test_stream_empty_paths_raise_and_single_batch_works(corpus_paths):
+    from dmlc_tpu.parallel.inference import InferenceEngine
+
+    engine = InferenceEngine("tinynet", batch_size=8, seed=9)
+    with pytest.raises(ValueError, match="empty"):
+        engine.run_paths_stream([])
+    # A single (even sub-batch-size) corpus still flows through all stages.
+    r = engine.run_paths_stream(corpus_paths[:2])
+    assert r.top1_index.shape == (2,)
+    s = engine.ingest_summary()
+    assert s["decode"]["count"] == s["dispatch"]["count"] == 1
+
+
+def test_stream_prefetch_one_still_correct(corpus_paths):
+    # prefetch=1 degenerates to decode-then-stage per batch — slower, never
+    # wrong; prefetch<1 is clamped rather than rejected.
+    from dmlc_tpu.parallel.inference import InferenceEngine
+
+    engine = InferenceEngine("tinynet", batch_size=8, seed=10)
+    a = engine.run_paths_stream(corpus_paths[:20], prefetch=1)
+    b = engine.run_paths_stream(corpus_paths[:20], prefetch=0)
+    np.testing.assert_array_equal(a.top1_index, b.top1_index)
+
+
+def test_load_batch_into_empty_batch():
+    out = np.empty((0, 32, 32, 3), np.uint8)
+    assert pp.load_batch_into(out, [], size=32) is out
+
+
+def test_native_pool_shutdown_restarts(corpus_paths):
+    from dmlc_tpu import native
+
+    if not native.available():
+        pytest.skip("native pipeline not built")
+    native.decode_resize_batch(corpus_paths[:2], 48)
+    assert native.pool_size() > 0
+    native.pool_shutdown()
+    assert native.pool_size() == 0
+    # The next batch call regrows the pool transparently.
+    _, status = native.decode_resize_batch(corpus_paths[:2], 48)
+    assert not status.any() and native.pool_size() > 0
+
+
+def test_normalize_device_constants_cached():
+    from dmlc_tpu.ops.preprocess import _device_const
+
+    a = _device_const(pp.IMAGENET_MEAN)
+    assert _device_const(pp.IMAGENET_MEAN) is a
+    m1, s1 = pp.device_stats_for_model("resnet18")
+    m2, _ = pp.device_stats_for_model("resnet50")
+    assert m1 is m2  # same stats family -> same device constant
+    assert m1 is _device_const(pp.IMAGENET_MEAN)
+    out = np.asarray(pp.normalize(np.zeros((1, 2, 2, 3), np.uint8)))
+    np.testing.assert_allclose(
+        out[0, 0, 0], (0.0 - pp.IMAGENET_MEAN) / pp.IMAGENET_STD, rtol=1e-6
+    )
+    assert s1.shape == (3,)

@@ -365,6 +365,91 @@ def test_e1_allows_specific_and_observed_handlers():
 
 
 # ---------------------------------------------------------------------------
+# H1 — per-call pool construction inside marked hot paths
+# ---------------------------------------------------------------------------
+
+
+def test_h1_fires_on_pool_in_decorated_hot_path():
+    src = """
+    from concurrent.futures import ThreadPoolExecutor
+
+    from dmlc_tpu.utils.hotpath import hot_path
+
+    @hot_path
+    def load_batch(paths):
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            return list(pool.map(str, paths))
+    """
+    assert fired(src, "dmlc_tpu/ops/x.py") == ["H1"]
+
+
+def test_h1_fires_on_naming_convention_and_thread_ctor():
+    src = """
+    import concurrent.futures
+    import threading
+
+    def decode_hot(item):
+        t = threading.Thread(target=item)
+        t.start()
+        pool = concurrent.futures.ThreadPoolExecutor(4)
+        return pool
+    """
+    assert fired(src, "dmlc_tpu/parallel/x.py") == ["H1", "H1"]
+
+
+def test_h1_fires_inside_nested_closure_of_hot_path():
+    # A closure defined in a hot function executes per call too.
+    src = """
+    from concurrent.futures import ThreadPoolExecutor
+
+    from dmlc_tpu.utils.hotpath import hot_path
+
+    @hot_path
+    def serve(shard):
+        def decode():
+            return ThreadPoolExecutor(max_workers=1)
+        return decode()
+    """
+    assert fired(src, "dmlc_tpu/scheduler/x.py") == ["H1"]
+
+
+def test_h1_silent_on_unmarked_and_on_cached_pool_use():
+    src = """
+    from concurrent.futures import ThreadPoolExecutor
+
+    from dmlc_tpu.utils.hotpath import hot_path
+
+    _POOL = None
+
+    def _host_pool():
+        global _POOL
+        if _POOL is None:
+            _POOL = ThreadPoolExecutor(max_workers=8)  # built once, not hot
+        return _POOL
+
+    @hot_path
+    def load_batch(paths):
+        return list(_host_pool().map(str, paths))
+    """
+    assert fired(src, "dmlc_tpu/ops/x.py") == []
+
+
+def test_h1_suppression_with_justification():
+    src = """
+    import threading
+
+    from dmlc_tpu.utils.hotpath import hot_path
+
+    @hot_path
+    def flush_hot(cb):
+        # dmlc-lint: disable=H1 -- one-shot watchdog thread per flush is the design
+        t = threading.Thread(target=cb)
+        t.start()
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == []
+
+
+# ---------------------------------------------------------------------------
 # S1 — suppressions need justification
 # ---------------------------------------------------------------------------
 
@@ -422,7 +507,7 @@ def test_cli_lists_all_rules_and_exits_nonzero_on_findings(tmp_path):
         cwd=REPO, capture_output=True, text=True, timeout=60,
     )
     assert r.returncode == 0
-    for rule_id in ("D1", "J1", "J2", "J3", "L1", "E1", "S1"):
+    for rule_id in ("D1", "J1", "J2", "J3", "L1", "E1", "H1", "S1"):
         assert rule_id in r.stdout
     bad = tmp_path / "dmlc_tpu" / "cluster"
     bad.mkdir(parents=True)

@@ -1,0 +1,201 @@
+"""DynamicBatcher pins (scheduler/worker.py): request coalescing, deadline
+semantics, result mapping, error propagation, backend passthrough, and the
+acceptance bar — N>=8 concurrent single-image requests ride <= ceil(N/batch)
+device dispatches.
+
+Hermetic: the "device" is a fake predict fn that records call sizes; no JAX.
+"""
+
+import threading
+import time
+
+import pytest
+
+from dmlc_tpu.cluster.rpc import RpcError
+from dmlc_tpu.scheduler.worker import DynamicBatcher
+
+
+class FakePredict:
+    """Records every dispatched batch; predicts int(synset) deterministically."""
+
+    def __init__(self, delay_s: float = 0.0, fail: bool = False):
+        self.calls: list[list[str]] = []
+        self.delay_s = delay_s
+        self.fail = fail
+        self._lock = threading.Lock()
+
+    def __call__(self, synsets):
+        with self._lock:
+            self.calls.append(list(synsets))
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.fail:
+            raise RpcError("backend down")
+        return [int(s) for s in synsets]
+
+    # Backend-capability stand-ins for the passthrough test.
+    def warmup(self):
+        return "warm"
+
+    def predict_gang(self, synsets, rank, world):
+        return [0] * len(synsets)
+
+
+def test_coalesces_concurrent_requests_acceptance():
+    """N=12 single-image requests from concurrent callers -> <= ceil(12/8)=2
+    device dispatches, each caller getting its own prediction back."""
+    fake = FakePredict()
+    batcher = DynamicBatcher(fake, batch_size=8, max_wait_s=0.25)
+    try:
+        n = 12
+        results: dict[int, int] = {}
+        barrier = threading.Barrier(n)
+
+        def one(i: int) -> None:
+            barrier.wait()
+            results[i] = batcher([str(i)])[0]
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert results == {i: i for i in range(n)}
+        assert sum(len(c) for c in fake.calls) == n
+        assert len(fake.calls) <= -(-n // 8), (
+            f"{len(fake.calls)} dispatches for {n} requests: {fake.calls}"
+        )
+        s = batcher.summary()
+        assert s["requests"] == n and s["dispatches"] == len(fake.calls)
+        assert s["mean_fill"] > 0.5
+    finally:
+        batcher.stop()
+
+
+def test_full_batch_dispatches_without_waiting_deadline():
+    fake = FakePredict()
+    batcher = DynamicBatcher(fake, batch_size=4, max_wait_s=30.0)
+    try:
+        t0 = time.perf_counter()
+        preds = batcher(["1", "2", "3", "4"])
+        elapsed = time.perf_counter() - t0
+        assert preds == [1, 2, 3, 4]
+        assert elapsed < 5.0  # did NOT sit out the 30 s deadline
+        assert fake.calls == [["1", "2", "3", "4"]]
+    finally:
+        batcher.stop()
+
+
+def test_deadline_dispatches_partial_batch():
+    fake = FakePredict()
+    batcher = DynamicBatcher(fake, batch_size=8, max_wait_s=0.05)
+    try:
+        assert batcher(["7"]) == [7]  # lone request: rides the deadline
+        assert fake.calls == [["7"]]
+        assert batcher.summary()["mean_fill"] == pytest.approx(1 / 8)
+    finally:
+        batcher.stop()
+
+
+def test_oversized_request_splits_into_device_batches():
+    fake = FakePredict()
+    batcher = DynamicBatcher(fake, batch_size=4, max_wait_s=0.05)
+    try:
+        preds = batcher([str(i) for i in range(10)])
+        assert preds == list(range(10))
+        assert all(len(c) <= 4 for c in fake.calls)
+        assert sum(len(c) for c in fake.calls) == 10
+    finally:
+        batcher.stop()
+
+
+def test_backend_error_propagates_to_every_waiter():
+    batcher = DynamicBatcher(FakePredict(fail=True), batch_size=4, max_wait_s=0.02)
+    try:
+        with pytest.raises(RpcError, match="backend down"):
+            batcher(["1", "2"])
+    finally:
+        batcher.stop()
+
+
+def test_wrong_prediction_count_is_an_error():
+    batcher = DynamicBatcher(lambda synsets: [0], batch_size=4, max_wait_s=0.02)
+    try:
+        with pytest.raises(RpcError, match="predictions"):
+            batcher(["1", "2", "3"])
+    finally:
+        batcher.stop()
+
+
+def test_stop_drains_queue_then_rejects_new_work():
+    fake = FakePredict(delay_s=0.05)
+    batcher = DynamicBatcher(fake, batch_size=2, max_wait_s=0.01)
+    futs = [batcher.submit(str(i)) for i in range(4)]
+    batcher.stop()
+    assert [f.result(timeout=5) for f in futs] == [0, 1, 2, 3]
+    with pytest.raises(RuntimeError, match="stopped"):
+        batcher.submit("5")
+
+
+def test_backend_capability_passthrough():
+    fake = FakePredict()
+    batcher = DynamicBatcher(fake, batch_size=4)
+    try:
+        assert batcher.warmup() == "warm"  # delegated, not swallowed
+        assert hasattr(batcher, "predict_gang")
+        assert batcher.predict_gang(["a", "b"], 0, 1) == [0, 0]
+        assert not hasattr(batcher, "decode_gang")  # absence passes through too
+    finally:
+        batcher.stop()
+
+
+def test_submit_returns_future_per_request():
+    fake = FakePredict()
+    batcher = DynamicBatcher(fake, batch_size=2, max_wait_s=0.02)
+    try:
+        f1, f2 = batcher.submit("4"), batcher.submit("9")
+        assert f1.result(timeout=5) == 4 and f2.result(timeout=5) == 9
+    finally:
+        batcher.stop()
+
+
+def test_sequential_calls_reuse_one_worker():
+    # The batcher's worker thread is persistent: sequential traffic keeps
+    # dispatching without respawn, and counters accumulate across calls.
+    fake = FakePredict()
+    batcher = DynamicBatcher(fake, batch_size=2, max_wait_s=0.02)
+    try:
+        assert batcher(["1", "2"]) == [1, 2]
+        assert batcher(["3", "4"]) == [3, 4]
+        s = batcher.summary()
+        assert s["requests"] == 4 and s["dispatches"] == 2
+        assert s["mean_fill"] == pytest.approx(1.0)
+    finally:
+        batcher.stop()
+
+
+def test_predict_worker_serves_through_batcher():
+    # The RPC surface (`job.predict`) works unchanged over a wrapped backend.
+    from dmlc_tpu.scheduler.worker import PredictWorker
+
+    fake = FakePredict()
+    batcher = DynamicBatcher(fake, batch_size=4, max_wait_s=0.02)
+    try:
+        worker = PredictWorker({"m": batcher})
+        reply = worker._predict({"model": "m", "synsets": ["3", "1"]})
+        assert reply["predictions"] == [3, 1]
+        # Gang verbs bypass the batcher via attribute passthrough.
+        assert worker._predict_gang(
+            {"model": "m", "synsets": ["3", "1"], "rank": 0, "world": 1}
+        )["predictions"] == [0, 0]
+        assert [c for c in fake.calls if c] == [["3", "1"]]  # one batched dispatch
+    finally:
+        batcher.stop()
+
+
+def test_node_config_has_microbatch_knob():
+    from dmlc_tpu.utils.config import ClusterConfig
+
+    cfg = ClusterConfig()
+    assert cfg.microbatch_wait_s == 0.0  # off by default
+    assert cfg.with_updates(microbatch_wait_s=0.002).microbatch_wait_s == 0.002

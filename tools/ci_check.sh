@@ -7,8 +7,12 @@
 #                         (incremental adoption: other packages are not
 #                         yet annotation-complete)
 #   4. clang-tidy       — native/*.cpp static analysis (.clang-tidy)
-#   5. sanitizer smoke  — make sanitize + ASan/TSan decode over corrupt
-#                         JPEG fixtures (tests/test_native_sanitize.py)
+#   5. native build     — the production .so (persistent decode pool)
+#                         must compile from source
+#   6. sanitizer smoke  — make sanitize + ASan/TSan decode over corrupt
+#                         JPEG fixtures through the PERSISTENT pool, incl.
+#                         concurrent submitters and pool shutdown/regrow
+#                         (tests/test_native_sanitize.py)
 #
 # Tools the image does not ship (ruff, mypy, clang-tidy) are SKIPPED with
 # a notice instead of failing the gate — the repo must not depend on
@@ -52,7 +56,18 @@ else
   note "clang-tidy SKIPPED (not installed in this image)"
 fi
 
-note "sanitizer smoke (make sanitize + corrupt-JPEG decode)"
+note "native build (persistent decode pool .so)"
+if command -v g++ >/dev/null 2>&1 && command -v make >/dev/null 2>&1; then
+  if make -s -C native; then
+    note "native build OK"
+  else
+    fail=1
+  fi
+else
+  note "native build SKIPPED (g++/make not in this image)"
+fi
+
+note "sanitizer smoke (make sanitize + corrupt-JPEG decode via the persistent pool)"
 if env JAX_PLATFORMS=cpu python -m pytest tests/test_native_sanitize.py -q \
     -p no:cacheprovider; then
   note "sanitizer smoke OK"

@@ -55,7 +55,7 @@ class ImportMap:
         return self.resolve(dotted_name(node))
 
 
-from tools.lint.rules import excepts, jit, locks, wallclock  # noqa: E402
+from tools.lint.rules import excepts, hotpath, jit, locks, wallclock  # noqa: E402
 
 RULES = [
     wallclock.D1,
@@ -64,4 +64,5 @@ RULES = [
     jit.J3,
     locks.L1,
     excepts.E1,
+    hotpath.H1,
 ]

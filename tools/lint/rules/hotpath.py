@@ -1,0 +1,92 @@
+"""H1 — no per-call thread/pool construction inside marked hot paths.
+
+The ingest overhaul replaced every per-call ``ThreadPoolExecutor`` (and the
+native library's ``std::thread``-per-call spawn/join) on the decode/serve
+path with persistent cached pools: at serving steady state a fresh pool per
+batch is thread churn on every shard and caps the stage's concurrency at
+whatever the transient pool happens to be sized. This rule keeps the
+regression from coming back.
+
+A function is a *marked hot path* when it is decorated with ``@hot_path``
+(``dmlc_tpu/utils/hotpath.py``) or its name ends in ``_hot`` (the naming
+convention for code that cannot take the decorator). Inside a marked
+function — including nested functions/closures, which execute per call —
+constructing ``ThreadPoolExecutor`` / ``ProcessPoolExecutor`` /
+``threading.Thread`` / ``multiprocessing.Pool`` is flagged. Build the pool
+once at module or object scope (``ops/preprocess._host_pool``,
+``parallel/inference._stage_pool``) and submit to it instead.
+
+The C++ twin of this invariant — no ``std::thread``-per-call in
+``native/image_pipeline.cpp`` — is enforced structurally by the persistent
+``DecodePool`` plus its concurrent-submitter TSan/ASan smoke
+(``native/sanitize_main.cpp``), not by this Python-AST rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Finding
+from tools.lint.rules import ImportMap
+
+#: Canonical dotted paths whose construction means "a worker pool / thread
+#: is being built right here, per call".
+_POOL_CTORS = {
+    "concurrent.futures.ThreadPoolExecutor": "ThreadPoolExecutor",
+    "concurrent.futures.thread.ThreadPoolExecutor": "ThreadPoolExecutor",
+    "concurrent.futures.ProcessPoolExecutor": "ProcessPoolExecutor",
+    "concurrent.futures.process.ProcessPoolExecutor": "ProcessPoolExecutor",
+    "threading.Thread": "threading.Thread",
+    "multiprocessing.Pool": "multiprocessing.Pool",
+}
+
+
+def _is_hot(fn: ast.FunctionDef | ast.AsyncFunctionDef, imports: ImportMap) -> bool:
+    for dec in fn.decorator_list:
+        node = dec.func if isinstance(dec, ast.Call) else dec
+        name = imports.resolve_node(node) or ""
+        if name.rsplit(".", 1)[-1] == "hot_path":
+            return True
+    return fn.name.endswith("_hot")
+
+
+class _H1:
+    id = "H1"
+    summary = "thread/pool constructed per call inside a marked hot path"
+    hint = ("hoist the executor/thread to a module- or object-level cached "
+            "pool built once (see ops/preprocess._host_pool, "
+            "parallel/inference._stage_pool) and submit work to it")
+    scope_doc = "everywhere (functions decorated @hot_path or named *_hot)"
+
+    def applies(self, relpath: str) -> bool:
+        return True
+
+    def check(self, tree: ast.AST, relpath: str) -> list[Finding]:
+        imports = ImportMap(tree)
+        findings: list[Finding] = []
+        seen: set[tuple[int, int]] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _is_hot(node, imports):
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                label = _POOL_CTORS.get(imports.resolve_node(sub.func) or "")
+                if label is None:
+                    continue
+                # A hot function nested in a hot function is walked twice;
+                # one finding per construction site.
+                if (sub.lineno, sub.col_offset) in seen:
+                    continue
+                seen.add((sub.lineno, sub.col_offset))
+                findings.append(Finding(
+                    relpath, sub.lineno, sub.col_offset, self.id,
+                    f"{label} constructed inside hot path {node.name!r}: "
+                    "per-call pool spawn/join on the serving data plane",
+                ))
+        return findings
+
+
+H1 = _H1()
