@@ -56,6 +56,8 @@ Commands (reference: README.md:10-23):
   delete | d <sdfs_name>                delete all versions
   ls [<sdfs_name>]                      where files live (leader directory)
   store | s                             files stored on this node
+  scrub                                 verify this node's blobs against their
+                                        sha256 sidecars (rot -> quarantine + heal)
   train | t                             broadcast model weights to members
   predict                               start/resume the inference jobs
   export <model>                        publish the model's StableHLO executable
@@ -151,6 +153,15 @@ class Cli:
                 for name, vs in sorted(n.store.listing().items())
             ]
             return format_table(["name", "versions"], rows)
+        if cmd == "scrub":
+            report = n.scrub()
+            if report["corrupt"]:
+                bad = ", ".join(f"{name} v{v}" for name, v in report["corrupt"])
+                return (
+                    f"scrubbed {report['scanned']} blob(s); QUARANTINED {bad} "
+                    "(reported to leader for re-replication)"
+                )
+            return f"scrubbed {report['scanned']} blob(s); all digests verified"
         if cmd in ("train", "t"):
             results = n.train()
             rows = [

@@ -33,7 +33,7 @@ def wait_until(cond, timeout: float = 30.0, interval: float = 0.02, msg: str = "
 def make_synsets(path: Path, n: int) -> Path:
     """A synset_words.txt with n synthetic classes (truth = line index)."""
     path.parent.mkdir(parents=True, exist_ok=True)
-    path.write_text("".join(f"n{i:08d} label {i}\n" for i in range(n)))
+    path.write_text("".join(f"n{i:08d} label {i}\n" for i in range(n)))  # dmlc-lint: disable=F1 -- test-harness workload fixture, not replicated cluster state; rebuilt per run
     return path
 
 

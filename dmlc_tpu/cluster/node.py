@@ -92,6 +92,7 @@ class ClusterNode:
         self.rpc = TcpRpc(auth=self.auth)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        self._announced = False  # restart inventory re-announce (probe loop)
 
         # --- L1 membership over UDP gossip -----------------------------
         self.gossip = UdpTransport(config.host, config.gossip_port, auth=self.auth)
@@ -324,6 +325,8 @@ class ClusterNode:
                     log.exception("eager warmup failed; backend will build lazily")
         self._spawn(self._membership_loop)
         self._spawn(self._probe_loop)
+        if self.config.scrub_interval_s > 0:
+            self._spawn(self._scrub_loop)
         if self.is_candidate:
             self._spawn(self._heal_loop)
             self._spawn(self._assign_loop)
@@ -362,8 +365,55 @@ class ClusterNode:
         def body():
             self.tracker.probe()
             self.sdfs.leader_addr = self.tracker.current
+            if not self._announced:
+                self._try_announce()
 
         self._loop(self.config.leader_probe_interval_s, body)
+
+    def _try_announce(self) -> None:
+        """Push this store's recovered inventory to the acting leader
+        (sdfs.announce) so a restarted member's replicas re-enter the
+        directory instead of being healed around. Retried each probe tick
+        until a leader accepts it (a standby refuses writes)."""
+        try:
+            reply = self.rpc.call(
+                self.tracker.current,
+                "sdfs.announce",
+                {"member": self.self_member_addr, "inventory": self.store.inventory()},
+                timeout=5.0,
+            )
+        except Exception as e:
+            log.debug("inventory announce deferred: %s", e)
+            return
+        self._announced = True
+        # The leader's verdicts on our recovered state: names wholly below
+        # a delete tombstone are dropped, digest-divergent copies park in
+        # quarantine (never served, never a heal source).
+        for name in reply.get("dead", []):
+            self.store.delete(name)
+        for name, version in reply.get("corrupt", []):
+            self.store.quarantine(name, int(version))
+
+    def _scrub_loop(self):
+        """Member-side anti-entropy: re-hash a bounded batch of stored
+        blobs per tick; quarantine rot locally and report it to the leader
+        so heal_once re-places from verified replicas."""
+
+        def body():
+            _, corrupt = self.store.scrub_once(self.config.scrub_batch)
+            for name, version in corrupt:
+                self.sdfs.report_corrupt(name, version, self.self_member_addr)
+
+        self._loop(self.config.scrub_interval_s, body)
+
+    def scrub(self) -> dict:
+        """CLI verb: one FULL verification pass over this node's store
+        (the periodic loop scrubs incrementally); corrupt copies are
+        quarantined and reported for healing."""
+        scanned, corrupt = self.store.scrub_once(None)
+        for name, version in corrupt:
+            self.sdfs.report_corrupt(name, version, self.self_member_addr)
+        return {"scanned": scanned, "corrupt": corrupt}
 
     def _heal_loop(self):
         self._loop(
@@ -446,6 +496,9 @@ class ClusterNode:
                             "version": info["version"],
                             "source": info["replicas"][0],
                             "from_stage": False,
+                            # The puller verifies the weights against the
+                            # directory digest before committing them.
+                            "digest": info.get("digest"),
                         },
                     )
                     pulled.append(member)
@@ -453,7 +506,8 @@ class ClusterNode:
                         self.rpc.call(
                             self.tracker.current,
                             "sdfs.record",
-                            {"name": sdfs_name, "version": info["version"], "member": member},
+                            {"name": sdfs_name, "version": info["version"],
+                             "member": member, "digest": info.get("digest")},
                         )
                     except Exception as e:
                         log.warning("train: record %s@%s: %s", sdfs_name, member, e)

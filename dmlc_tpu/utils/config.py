@@ -55,6 +55,14 @@ class ClusterConfig:
     # Concurrent replica copies per placement (reference: 10-way scp fanout,
     # services.rs:367-373).
     replicate_fanout: int = 4
+    # Anti-entropy scrub: every node re-hashes its stored blobs against
+    # their committed sha256 sidecars on this cadence, quarantining and
+    # reporting rot so healing re-places from verified copies (docs/SDFS.md).
+    # 0 disables the loop (sdfs.scrub / the CLI verb still work on demand).
+    scrub_interval_s: float = 30.0
+    # Blobs re-hashed per scrub pass (round-robin cursor): bounds the I/O a
+    # single pass can burn on a store full of multi-GB checkpoints.
+    scrub_batch: int = 4
 
     # --- scheduler ---
     assignment_interval_s: float = 3.0  # src/services.rs:201

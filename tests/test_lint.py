@@ -484,6 +484,68 @@ def test_suppression_in_string_literal_is_inert():
 
 
 # ---------------------------------------------------------------------------
+# F1 — bare persistence in cluster/ outside the atomic-write helper
+# ---------------------------------------------------------------------------
+
+
+def test_f1_fires_on_write_bytes_and_write_text_in_cluster():
+    src = """
+    from pathlib import Path
+
+    def save(path: Path, data: bytes):
+        path.write_bytes(data)
+        path.with_suffix(".meta").write_text("{}")
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == ["F1", "F1"]
+
+
+def test_f1_fires_on_open_for_write_modes():
+    src = """
+    def save(path, data):
+        with open(path, "wb") as f:
+            f.write(data)
+        with open(path, mode="a") as f:
+            f.write("tail")
+        with open(path, "r+b") as f:
+            f.write(data)
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == ["F1", "F1", "F1"]
+
+
+def test_f1_silent_on_reads_and_outside_cluster():
+    src = """
+    from pathlib import Path
+
+    def load(path: Path):
+        with open(path) as f:
+            a = f.read()
+        with open(path, "rb") as f:
+            b = f.read()
+        return a, b, path.read_bytes()
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == []
+    writes = """
+    from pathlib import Path
+
+    def save(path: Path, data: bytes):
+        path.write_bytes(data)
+    """
+    # Outside cluster/ (and in the helper itself) the rule does not apply.
+    assert fired(writes, "dmlc_tpu/utils/x.py") == []
+    assert fired(writes, "dmlc_tpu/cluster/diskio.py") == []
+
+
+def test_f1_suppression_with_justification():
+    src = """
+    def assemble(scratch, chunks):
+        with open(scratch, "wb") as f:  # dmlc-lint: disable=F1 -- scratch file, committed later by fsync+rename
+            for c in chunks:
+                f.write(c)
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == []
+
+
+# ---------------------------------------------------------------------------
 # the real tree + the CLI contract
 # ---------------------------------------------------------------------------
 
@@ -507,7 +569,7 @@ def test_cli_lists_all_rules_and_exits_nonzero_on_findings(tmp_path):
         cwd=REPO, capture_output=True, text=True, timeout=60,
     )
     assert r.returncode == 0
-    for rule_id in ("D1", "J1", "J2", "J3", "L1", "E1", "H1", "S1"):
+    for rule_id in ("D1", "J1", "J2", "J3", "L1", "E1", "H1", "F1", "S1"):
         assert rule_id in r.stdout
     bad = tmp_path / "dmlc_tpu" / "cluster"
     bad.mkdir(parents=True)

@@ -198,21 +198,38 @@ def test_colliding_names_coexist_on_one_member(tmp_path):
     assert store.read("a/b", 1) == b"slash"  # survives the sibling's delete
 
 
-def test_boot_wipes_stale_store(tmp_path):
-    def blobs():
-        # Everything except the store's internal scratch dirs.
-        return [p for p in (tmp_path / "s").iterdir() if not p.name.startswith(".")]
-
+def test_boot_recovers_committed_blobs_and_wipes_scratch(tmp_path):
+    """Restart recovery (docs/SDFS.md): committed blobs — sidecar present,
+    size intact — survive a reboot with their digests; in-flight staged
+    bytes and anything without a sidecar (crash before the commit point)
+    are discarded."""
     store = MemberStore(tmp_path / "s")
     store.receive("f", 1, b"old")
+    digest = store.digest_of("f", 1)
     store.stage("leaky", b"staged-bytes")
-    assert blobs()
+    # A blob that never reached its commit point: bytes, no sidecar.
+    store.blob_path("torn", 1).write_bytes(b"half-written")
+
     fresh = MemberStore(tmp_path / "s")  # reboot
-    assert fresh.listing() == {}
-    assert not blobs()
-    # Stale staged bytes are wiped too (they live under .staged/).
+    assert fresh.listing() == {"f": [1]}
+    assert fresh.read("f", 1) == b"old"
+    assert fresh.digest_of("f", 1) == digest
+    assert not fresh.blob_path("torn", 1).exists()
+    # Stale staged bytes are wiped (they live under .staged/).
     with pytest.raises(KeyError):
         fresh.staged_size("leaky")
+
+
+def test_boot_discards_truncated_blobs(tmp_path):
+    """A blob whose on-disk size disagrees with its committed sidecar
+    (torn write the rename ordering should prevent, or post-crash media
+    truncation) is dropped at recovery, not indexed and served."""
+    store = MemberStore(tmp_path / "s")
+    store.receive("f", 1, b"full-content")
+    store.blob_path("f", 1).write_bytes(b"full")  # truncate behind its back
+    fresh = MemberStore(tmp_path / "s")
+    assert fresh.listing() == {}
+    assert not store.blob_path("f", 1).exists()
 
 
 def test_chunked_transfer_never_exceeds_tiny_max_frame(tmp_path, monkeypatch):

@@ -13,6 +13,11 @@
 #                         JPEG fixtures through the PERSISTENT pool, incl.
 #                         concurrent submitters and pool shutdown/regrow
 #                         (tests/test_native_sanitize.py)
+#   7. chaos matrix     — the seeded fault-injection suites (crashes,
+#                         partitions, failover, disk bit-rot/torn writes)
+#                         across a 3-seed-base matrix: each leg offsets
+#                         every parametrized seed range into a disjoint
+#                         region of the fault space (DMLC_CHAOS_SEED)
 #
 # Tools the image does not ship (ruff, mypy, clang-tidy) are SKIPPED with
 # a notice instead of failing the gate — the repo must not depend on
@@ -74,6 +79,18 @@ if env JAX_PLATFORMS=cpu python -m pytest tests/test_native_sanitize.py -q \
 else
   fail=1
 fi
+
+note "chaos suite (3-seed matrix: crashes/partitions/failover x disk faults)"
+for seed_base in 0 1000 2000; do
+  note "chaos matrix leg DMLC_CHAOS_SEED=$seed_base"
+  if env JAX_PLATFORMS=cpu DMLC_CHAOS_SEED="$seed_base" python -m pytest \
+      tests/test_chaos.py tests/test_sdfs_faults.py -q -p no:cacheprovider; then
+    note "chaos leg $seed_base OK"
+  else
+    note "chaos leg $seed_base FAILED (replay: DMLC_CHAOS_SEED=$seed_base pytest tests/test_chaos.py tests/test_sdfs_faults.py)"
+    fail=1
+  fi
+done
 
 if [ "$fail" -ne 0 ]; then
   note "ci_check FAILED"

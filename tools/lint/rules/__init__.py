@@ -55,7 +55,14 @@ class ImportMap:
         return self.resolve(dotted_name(node))
 
 
-from tools.lint.rules import excepts, hotpath, jit, locks, wallclock  # noqa: E402
+from tools.lint.rules import (  # noqa: E402
+    excepts,
+    hotpath,
+    jit,
+    locks,
+    persistence,
+    wallclock,
+)
 
 RULES = [
     wallclock.D1,
@@ -65,4 +72,5 @@ RULES = [
     locks.L1,
     excepts.E1,
     hotpath.H1,
+    persistence.F1,
 ]

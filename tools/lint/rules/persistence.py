@@ -1,0 +1,90 @@
+"""F1 — no bare persistence in ``cluster/`` outside the atomic-write helper.
+
+The storage tier's crash-durability contract (docs/SDFS.md) is that every
+write of cluster state goes temp-file -> fsync -> atomic rename -> dir
+fsync, via ``dmlc_tpu/cluster/diskio.py``. A single bare ``write_bytes`` /
+``write_text`` / ``open(..., "w")`` reintroduces torn writes the restart
+recovery and digest verification can't reason about — a crash mid-write
+leaves a half blob that a replica pull may then propagate.
+
+Flagged inside ``dmlc_tpu/cluster/`` (the helper module itself is exempt —
+it IS the primitive):
+
+- ``<x>.write_bytes(...)`` / ``<x>.write_text(...)`` calls
+- ``open(path, mode)`` where the literal mode writes ("w", "a", "x", "+")
+
+Legitimate exceptions (scratch files finalized by a later fsync+rename,
+caller-owned download paths, deliberate non-durable fault simulation) use
+the standard justified suppression: ``# dmlc-lint: disable=F1 -- why``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Finding
+from tools.lint.rules import ImportMap
+
+_WRITE_ATTRS = {"write_bytes", "write_text"}
+_OPEN_NAMES = {"open", "io.open", "builtins.open"}
+
+
+def _write_mode(call: ast.Call) -> str | None:
+    """The literal mode string of an ``open`` call iff it writes."""
+    mode: ast.expr | None = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return None  # default "r": read-only
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value if any(c in mode.value for c in "wax+") else None
+    return None  # dynamic mode: out of scope for a file-local rule
+
+
+class _F1:
+    id = "F1"
+    summary = "bare persistence in cluster/ outside the atomic-write helper"
+    hint = ("route the write through dmlc_tpu/cluster/diskio.py "
+            "(atomic_write / atomic_copy / atomic_install: temp -> fsync -> "
+            "rename -> dir fsync), or justify why a torn write is safe with "
+            "'# dmlc-lint: disable=F1 -- why'")
+    scope_doc = "dmlc_tpu/cluster/ (diskio.py, the helper itself, is exempt)"
+
+    def applies(self, relpath: str) -> bool:
+        return (
+            relpath.startswith("dmlc_tpu/cluster/")
+            and not relpath.endswith("/diskio.py")
+        )
+
+    def check(self, tree: ast.AST, relpath: str) -> list[Finding]:
+        imports = ImportMap(tree)
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in _WRITE_ATTRS:
+                findings.append(Finding(
+                    relpath, node.lineno, node.col_offset, self.id,
+                    f".{func.attr}() bypasses the temp+fsync+rename helper: "
+                    "a crash mid-write leaves a torn file readable by "
+                    "recovery and replica pulls",
+                ))
+                continue
+            resolved = imports.resolve_node(func) or ""
+            if resolved in _OPEN_NAMES:
+                mode = _write_mode(node)
+                if mode is not None:
+                    findings.append(Finding(
+                        relpath, node.lineno, node.col_offset, self.id,
+                        f"open(..., {mode!r}) writes without the "
+                        "temp+fsync+rename helper: a crash mid-write leaves "
+                        "a torn file",
+                    ))
+        return findings
+
+
+F1 = _F1()
