@@ -67,6 +67,9 @@ Commands (reference: README.md:10-23):
   mesh-join                             join the fleet-wide jax.distributed mesh
   jobs                                  job status, accuracy, latency percentiles
   assign                                per-job member assignment table
+  status                                overload-control counters: sheds,
+                                        deadline trips, queue high-water,
+                                        breakers, gray-demoted members
   trace on|off|summary|export <path>    span tracing: toggle, aggregate table,
                                         Chrome trace JSON (chrome://tracing)
   help                                  this text
@@ -246,6 +249,53 @@ class Cli:
                 for job, members in sorted(n.assignments().items())
             ]
             return format_table(["job", "#members", "members"], rows)
+        if cmd == "status":
+            s = n.status()
+            out = [f"node {s['member']}  (believed leader: {s['leader']})"]
+            counters = {k: v for k, v in sorted(s["counters"].items()) if v}
+            out.append(
+                "  counters: "
+                + (", ".join(f"{k}={v}" for k, v in counters.items()) or "(all zero)")
+            )
+            for gate, g in sorted(s["gates"].items()):
+                out.append(
+                    f"  {gate} gate: active={g['active']} admitted={g['admitted']} "
+                    f"shed={g['sheds']} queue_hw={g['queue_hw']} "
+                    f"(max_inflight={g['max_inflight']}, max_queue={g['max_queue']})"
+                )
+            for name, b in sorted(s.get("microbatch", {}).items()):
+                out.append(
+                    f"  microbatch[{name}]: requests={b['requests']} "
+                    f"dispatches={b['dispatches']} shed={b['sheds']} "
+                    f"queue_hw={b['queue_hw']}"
+                )
+            for dest, br in sorted(s.get("breakers", {}).items()):
+                out.append(
+                    f"  breaker {dest}: {br['state']} (opens={br['opens']}, "
+                    f"consec_failures={br['consec']})"
+                )
+            cluster = s.get("cluster")
+            if cluster:
+                ctrs = {k: v for k, v in sorted(cluster.get("counters", {}).items()) if v}
+                out.append(
+                    "  leader counters: "
+                    + (", ".join(f"{k}={v}" for k, v in ctrs.items()) or "(all zero)")
+                )
+                demoted = cluster.get("demoted", [])
+                out.append(
+                    "  gray-demoted: " + (", ".join(demoted) if demoted else "(none)")
+                )
+                for m, h in sorted(cluster.get("member_health", {}).items()):
+                    ewma = h.get("ewma_s")
+                    out.append(
+                        f"    {m}: ewma={ewma * 1e3:.1f}ms"
+                        + (f" DEMOTED ({h['reason']})" if h.get("demoted") else "")
+                        if ewma is not None
+                        else f"    {m}: DEMOTED ({h['reason']})"
+                    )
+            elif s.get("cluster_error"):
+                out.append(f"  leader unreachable: {s['cluster_error']}")
+            return "\n".join(out)
         if cmd == "trace":
             from dmlc_tpu.utils.tracing import tracer
 

@@ -1,0 +1,98 @@
+"""Admission control: bounded work queues that shed instead of buffering.
+
+The reference accepted every request unconditionally; under a burst that
+exceeds capacity, an unbounded queue converts overload into unbounded
+latency — every queued request eventually times out anyway, but only after
+holding memory and a thread for its full deadline (the queueing-theory
+death spiral). The production answer is to bound the queue and *shed
+immediately* at the door: a rejected caller learns in microseconds, retries
+elsewhere (or later, per the retry-after hint), and the work that IS
+admitted completes inside its deadline (docs/OVERLOAD.md).
+
+``AdmissionGate`` fronts a synchronous serving surface (PredictWorker's
+``job.predict``, the SDFS member's bulk-transfer verbs): up to
+``max_inflight`` requests execute while up to ``max_queue`` more wait
+(blocked on the backend's serialization); past that, ``admit`` raises
+``Overloaded`` with the retry-after hint. Counters (sheds, admitted,
+queue-depth high-water) flow to utils/metrics.Counters and the tracer.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Iterator
+
+from dmlc_tpu.cluster.rpc import Overloaded
+from dmlc_tpu.utils.tracing import tracer
+
+
+class AdmissionGate:
+    """Bounded-concurrency door for one class of work. Disabled (admits
+    everything, counts nothing) when ``max_inflight <= 0``."""
+
+    def __init__(
+        self,
+        max_inflight: int,
+        max_queue: int,
+        name: str = "work",
+        metrics=None,
+        retry_after_s: float = 0.25,
+    ):
+        self.max_inflight = int(max_inflight)
+        self.max_queue = max(0, int(max_queue))
+        self.name = name
+        self.metrics = metrics
+        self.retry_after_s = float(retry_after_s)
+        self._lock = threading.Lock()
+        self.active = 0
+        self.admitted = 0
+        self.sheds = 0
+        self.queue_hw = 0  # high-water of requests waiting beyond max_inflight
+
+    @property
+    def capacity(self) -> int:
+        return self.max_inflight + self.max_queue
+
+    @contextmanager
+    def admit(self) -> Iterator[None]:
+        """Hold one admission slot for the duration of the request; raise
+        ``Overloaded`` (with the retry-after hint) when the gate is full."""
+        if self.max_inflight <= 0:
+            yield
+            return
+        with self._lock:
+            if self.active >= self.capacity:
+                self.sheds += 1
+                if self.metrics is not None:
+                    self.metrics.inc("shed")
+                    self.metrics.inc(f"shed_{self.name}")
+                tracer.record(f"overload/shed_{self.name}", 0.0)
+                raise Overloaded(
+                    f"{self.name}: {self.active} in flight / queue full "
+                    f"(max_inflight={self.max_inflight}, max_queue={self.max_queue})",
+                    retry_after_s=self.retry_after_s,
+                )
+            self.active += 1
+            self.admitted += 1
+            waiting = self.active - self.max_inflight
+            if waiting > self.queue_hw:
+                self.queue_hw = waiting
+                if self.metrics is not None:
+                    self.metrics.observe_high(f"queue_hw_{self.name}", waiting)
+        try:
+            yield
+        finally:
+            with self._lock:
+                self.active -= 1
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "max_inflight": self.max_inflight,
+                "max_queue": self.max_queue,
+                "active": self.active,
+                "admitted": self.admitted,
+                "sheds": self.sheds,
+                "queue_hw": self.queue_hw,
+            }

@@ -35,14 +35,23 @@ def epoch_key(epoch) -> tuple[int, str]:
 
 
 class LeaderTracker:
-    """Which candidate do I currently believe is leader? Probe and advance."""
+    """Which candidate do I currently believe is leader? Probe and advance.
 
-    def __init__(self, rpc: Rpc, candidates: list[str]):
+    ``retry_policy`` (cluster/retrypolicy.py, optional) breaker-gates the
+    probes: once a candidate has failed enough consecutive probes its
+    breaker opens, and subsequent ticks SKIP the 2 s timeout against it —
+    advancing to the next candidate immediately — until the cooldown admits
+    one half-open probe. With every candidate down, a full wrap costs one
+    budgeted probe per cooldown window instead of candidates x timeout of
+    blocked probe-loop time per tick."""
+
+    def __init__(self, rpc: Rpc, candidates: list[str], retry_policy=None):
         if not candidates:
             raise ValueError("need at least one leader candidate")
         self.rpc = rpc
         self.candidates = list(candidates)
         self.index = 0
+        self.retry_policy = retry_policy
 
     @property
     def current(self) -> str:
@@ -53,13 +62,20 @@ class LeaderTracker:
         is reachable AND actively leading. Liveness alone is not enough: a
         rebooted ex-leader answers RPCs as a deferring standby, and routing
         verbs there would mutate state its sync loop immediately overwrites."""
-        try:
-            status = self.rpc.call(self.current, "leader.status", {}, timeout=timeout)
-            if status.get("leading"):
-                return True
-            reason = "alive but not leading"
-        except (RpcUnreachable, RpcError) as e:
-            reason = str(e)
+        if self.retry_policy is not None and not self.retry_policy.allow(self.current):
+            reason = "breaker open (recent probes failed)"
+        else:
+            try:
+                status = self.rpc.call(self.current, "leader.status", {}, timeout=timeout)
+                if self.retry_policy is not None:
+                    self.retry_policy.record(self.current)
+                if status.get("leading"):
+                    return True
+                reason = "alive but not leading"
+            except (RpcUnreachable, RpcError) as e:
+                if self.retry_policy is not None:
+                    self.retry_policy.record(self.current, e)
+                reason = str(e)
         prev = self.current
         self.index = (self.index + 1) % len(self.candidates)
         log.warning("leader %s (%s); trying %s", prev, reason, self.current)

@@ -29,9 +29,11 @@ import logging
 import threading
 from pathlib import Path
 
+from dmlc_tpu.cluster.admission import AdmissionGate
 from dmlc_tpu.cluster.clock import Clock
 from dmlc_tpu.cluster.failover import LeaderTracker, StandbyLeader
 from dmlc_tpu.cluster.membership import MembershipNode
+from dmlc_tpu.cluster.retrypolicy import RetryPolicy
 from dmlc_tpu.cluster.rpc import TcpRpc, TcpRpcServer
 from dmlc_tpu.cluster.sdfs import MemberStore, SdfsClient, SdfsLeader, SdfsMember
 from dmlc_tpu.cluster.transport import UdpTransport
@@ -44,6 +46,7 @@ from dmlc_tpu.scheduler.worker import (
     PredictWorker,
 )
 from dmlc_tpu.utils.config import ClusterConfig
+from dmlc_tpu.utils.metrics import Counters
 
 log = logging.getLogger(__name__)
 
@@ -94,6 +97,34 @@ class ClusterNode:
         self._threads: list[threading.Thread] = []
         self._announced = False  # restart inventory re-announce (probe loop)
 
+        # --- overload control (docs/OVERLOAD.md) ------------------------
+        # ONE counter registry and ONE retry governor per node, shared by
+        # every component: the CLI `status` verb and leader.status read the
+        # same numbers the gates/breakers write.
+        self.metrics = Counters()
+        self.retry_policy = RetryPolicy(
+            clock=self.clock.monotonic,
+            breaker_threshold=config.breaker_threshold,
+            breaker_cooldown_s=config.breaker_cooldown_s,
+            retry_rate_per_s=config.retry_rate_per_s,
+            retry_burst=config.retry_burst,
+            metrics=self.metrics,
+        )
+        self.predict_gate = AdmissionGate(
+            config.predict_max_inflight,
+            config.predict_max_queue,
+            name="predict",
+            metrics=self.metrics,
+            retry_after_s=config.shed_retry_after_s,
+        )
+        self.transfer_gate = AdmissionGate(
+            config.transfer_max_inflight,
+            config.transfer_max_queue,
+            name="transfer",
+            metrics=self.metrics,
+            retry_after_s=config.shed_retry_after_s,
+        )
+
         # --- L1 membership over UDP gossip -----------------------------
         self.gossip = UdpTransport(config.host, config.gossip_port, auth=self.auth)
         self.membership = MembershipNode(config, self.gossip, self.clock)
@@ -101,7 +132,11 @@ class ClusterNode:
         # --- member services (SDFS store + inference worker) -----------
         self.store = MemberStore(Path(config.storage_dir))
         self.sdfs_member = SdfsMember(
-            self.store, self.rpc, chunk_bytes=config.transfer_chunk_bytes
+            self.store,
+            self.rpc,
+            chunk_bytes=config.transfer_chunk_bytes,
+            transfer_timeout_s=config.transfer_deadline_s,
+            gate=self.transfer_gate,
         )
         if backends is None:
             if config.serve_from_executable:
@@ -119,15 +154,19 @@ class ClusterNode:
                     name: EngineBackend(name, config.data_dir, batch_size=config.batch_size)
                     for name in config.job_models
                 }
-        self.worker = PredictWorker(backends)
+        self.worker = PredictWorker(backends, gate=self.predict_gate)
         self.model_loader = ModelLoader(self.store, self.worker.backends)
         methods = {
             **self.sdfs_member.methods(),
             **self.worker.methods(),
             **self.model_loader.methods(),
             "node.info": self._node_info,
+            "node.status": lambda p: self.status(remote=False),
         }
-        self.member_server = TcpRpcServer(config.host, config.member_port, methods, auth=self.auth)
+        self.member_server = TcpRpcServer(
+            config.host, config.member_port, methods, auth=self.auth,
+            metrics=self.metrics,
+        )
         self.self_member_addr = self.member_server.address
 
         # --- leader-candidate machinery --------------------------------
@@ -135,7 +174,9 @@ class ClusterNode:
         self.leader_candidates = list(candidates)
         self.self_leader_addr = f"{config.host}:{config.leader_port}"
         self.is_candidate = self.self_leader_addr in self.leader_candidates
-        self.tracker = LeaderTracker(self.rpc, self.leader_candidates)
+        self.tracker = LeaderTracker(
+            self.rpc, self.leader_candidates, retry_policy=self.retry_policy
+        )
 
         self.leader_server = None
         self.sdfs_leader = None
@@ -151,6 +192,9 @@ class ClusterNode:
             self.store,
             self.self_member_addr,
             chunk_bytes=config.transfer_chunk_bytes,
+            timeout_s=config.rpc_deadline_s,
+            transfer_timeout_s=config.transfer_deadline_s,
+            retry_policy=self.retry_policy,
         )
         for backend in self.worker.backends.values():
             if isinstance(backend, ExportedBackend) and backend.sdfs is None:
@@ -182,6 +226,11 @@ class ClusterNode:
                     batch_size=config.batch_size,
                     max_wait_s=config.microbatch_wait_s,
                     name=f"microbatch-{name}",
+                    # Bounded queue + brownout: as the queue fills the
+                    # coalescing wait shrinks to zero, and a full queue
+                    # sheds with Overloaded (docs/OVERLOAD.md).
+                    max_queue=config.predict_max_queue,
+                    metrics=self.metrics,
                 )
                 self.worker.backends[name] = wrapped
                 self._batchers.append(wrapped)
@@ -207,6 +256,7 @@ class ClusterNode:
             # the next directory sync).
             is_leading=False,
             fanout=self.config.replicate_fanout,
+            transfer_timeout_s=self.config.transfer_deadline_s,
         )
         self._weight_cache: dict[str, tuple[int, float]] = {}
         self.scheduler = JobScheduler(
@@ -214,9 +264,15 @@ class ClusterNode:
             self.active_member_addrs,
             jobs={name: list(workload) for name in self.config.job_models},
             shard_size=self.config.dispatch_shard_size,
+            shard_timeout_s=self.config.predict_deadline_s,
             member_weight=self._member_weight,
             hedge_tail=self.config.hedge_tail,
             mesh_group=self._mesh_group,
+            retry_policy=self.retry_policy,
+            gray_factor=self.config.gray_factor,
+            gray_min_latency_s=self.config.gray_min_latency_s,
+            gray_probe_interval_s=self.config.gray_probe_interval_s,
+            metrics=self.metrics,
         )
         methods = {**self.sdfs_leader.methods(), **self.scheduler.methods()}
         if self.config.mesh_processes > 1:
@@ -229,7 +285,8 @@ class ClusterNode:
             )
             methods.update(self.mesh_bootstrap.methods())
         self.leader_server = TcpRpcServer(
-            self.config.host, self.config.leader_port, methods, auth=self.auth
+            self.config.host, self.config.leader_port, methods, auth=self.auth,
+            metrics=self.metrics,
         )
         # Leadership is claimed via StandbyLeader.step(), never assumed at
         # boot: a restarted ex-leader must defer to whoever promoted while
@@ -374,15 +431,25 @@ class ClusterNode:
         """Push this store's recovered inventory to the acting leader
         (sdfs.announce) so a restarted member's replicas re-enter the
         directory instead of being healed around. Retried each probe tick
-        until a leader accepts it (a standby refuses writes)."""
+        until a leader accepts it (a standby refuses writes) — through the
+        shared retry policy, so a down/drowning leader costs one budgeted
+        announce per breaker window, not one per tick."""
+        leader = self.tracker.current
+        if not self.retry_policy.allow_retry(leader):
+            return  # breaker open or budget dry: the next window retries
         try:
             reply = self.rpc.call(
-                self.tracker.current,
+                leader,
                 "sdfs.announce",
                 {"member": self.self_member_addr, "inventory": self.store.inventory()},
                 timeout=5.0,
             )
+            self.retry_policy.record(leader)
         except Exception as e:
+            from dmlc_tpu.cluster.rpc import RpcError
+
+            if isinstance(e, RpcError):
+                self.retry_policy.record(leader, e)
             log.debug("inventory announce deferred: %s", e)
             return
         self._announced = True
@@ -480,7 +547,10 @@ class ClusterNode:
             loaded: list[str] = []
             results[sdfs_name] = {"pulled": pulled, "loaded": loaded}
             try:
-                info = self.rpc.call(self.tracker.current, "sdfs.get", {"name": sdfs_name})
+                info = self.rpc.call(
+                    self.tracker.current, "sdfs.get", {"name": sdfs_name},
+                    timeout=self.config.rpc_deadline_s,
+                )
             except Exception as e:
                 log.warning("train: no weights for %s: %s", sdfs_name, e)
                 continue
@@ -500,6 +570,7 @@ class ClusterNode:
                             # directory digest before committing them.
                             "digest": info.get("digest"),
                         },
+                        timeout=self.config.transfer_deadline_s,
                     )
                     pulled.append(member)
                     try:
@@ -508,6 +579,7 @@ class ClusterNode:
                             "sdfs.record",
                             {"name": sdfs_name, "version": info["version"],
                              "member": member, "digest": info.get("digest")},
+                            timeout=self.config.rpc_deadline_s,
                         )
                     except Exception as e:
                         log.warning("train: record %s@%s: %s", sdfs_name, member, e)
@@ -548,10 +620,51 @@ class ClusterNode:
         )
 
     def predict(self) -> dict:
-        return self.rpc.call(self.tracker.current, "job.start", {})
+        return self.rpc.call(
+            self.tracker.current, "job.start", {}, timeout=self.config.rpc_deadline_s
+        )
 
     def jobs_report(self) -> dict:
-        return self.rpc.call(self.tracker.current, "job.report", {})["jobs"]
+        return self.rpc.call(
+            self.tracker.current, "job.report", {}, timeout=self.config.rpc_deadline_s
+        )["jobs"]
 
     def assignments(self) -> dict:
-        return self.rpc.call(self.tracker.current, "job.assignments", {})["assigned"]
+        return self.rpc.call(
+            self.tracker.current, "job.assignments", {},
+            timeout=self.config.rpc_deadline_s,
+        )["assigned"]
+
+    def status(self, remote: bool = True) -> dict:
+        """The overload-control picture from where this node stands
+        (docs/OVERLOAD.md): local admission gates + batcher queues + this
+        node's counters and breaker states, plus (with ``remote``) the
+        acting leader's scheduler-side verdicts — sheds, deadline trips,
+        breaker opens, gray demotions. Served as ``node.status`` too, so
+        operators can poll any member."""
+        out: dict = {
+            "member": self.self_member_addr,
+            "leader": self.tracker.current,
+            "counters": self.metrics.snapshot(),
+            "gates": {
+                "predict": self.predict_gate.summary(),
+                "transfer": self.transfer_gate.summary(),
+            },
+            "breakers": self.retry_policy.snapshot(),
+        }
+        if self._batchers:
+            out["microbatch"] = {
+                name: b.summary()
+                for name, b in self.worker.backends.items()
+                if isinstance(b, DynamicBatcher)
+            }
+        if remote:
+            try:
+                reply = self.rpc.call(
+                    self.tracker.current, "leader.status", {}, timeout=2.0
+                )
+                out["cluster"] = reply.get("overload", {})
+                out["cluster_leading"] = bool(reply.get("leading"))
+            except Exception as e:
+                out["cluster_error"] = str(e)
+        return out

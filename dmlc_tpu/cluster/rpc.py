@@ -5,10 +5,10 @@ The reference uses tarpc JSON-over-TCP for its Leader/Member services
 is a small synchronous RPC layer with two fabrics:
 
 - ``SimRpcNetwork`` — deterministic in-process dispatch for the simulator:
-  scriptable crashes and partitions, no sockets, no threads. This is what the
-  hermetic cluster tests run on (the fake-transport strategy the reference
-  declared via its unused ``mockstream`` dev-dependency but never built,
-  SURVEY.md §4).
+  scriptable crashes, partitions, and per-link latency, no sockets, no
+  threads. This is what the hermetic cluster tests run on (the
+  fake-transport strategy the reference declared via its unused
+  ``mockstream`` dev-dependency but never built, SURVEY.md §4).
 - ``TcpRpcServer`` / ``tcp_call`` — real length-prefixed msgpack frames over
   TCP for deployment, one connection per call (control traffic is tiny; bulk
   tensor bytes never ride this path — they go host->HBM via the staging
@@ -16,6 +16,16 @@ is a small synchronous RPC layer with two fabrics:
 
 A "service" is just a dict of method-name -> callable(payload dict) -> reply
 dict. Method errors travel back as ``RpcError`` with the remote message.
+
+Overload control (docs/OVERLOAD.md): every call carries a *deadline* — the
+remaining budget in seconds, frame field ``d`` — computed from the explicit
+timeout capped by any inherited deadline (cluster/deadline.py). Servers
+check the budget before AND after method execution and bind it ambiently,
+so nested calls (leader -> member -> SDFS pull) inherit the caller's budget
+instead of resetting to a fresh default. Typed failures —
+``DeadlineExceeded`` and ``Overloaded`` (with a retry-after hint) — survive
+the wire via message prefixes, so retry policy can tell "peer drowning"
+from "method bug".
 """
 
 from __future__ import annotations
@@ -24,10 +34,12 @@ import logging
 import socket
 import struct
 import threading
+from time import monotonic
 from typing import Callable
 
 import msgpack
 
+from dmlc_tpu.cluster import deadline as deadline_mod
 from dmlc_tpu.cluster.auth import AuthError, FrameAuth
 
 log = logging.getLogger(__name__)
@@ -43,10 +55,57 @@ class RpcUnreachable(RpcError):
     """The destination did not answer (down, partitioned, refused)."""
 
 
-class Rpc:
-    """Client interface: synchronous call to a named method at an address."""
+class DeadlineExceeded(RpcError):
+    """The call's propagated budget ran out (before dialing, on arrival, or
+    during method execution). Message always carries ``deadline:`` so the
+    verdict survives the fabric's error-to-string flattening."""
 
-    def call(self, addr: str, method: str, payload: dict, timeout: float = 60.0) -> dict:
+    def __init__(self, msg: str):
+        super().__init__(msg if "deadline:" in msg else f"deadline: {msg}")
+
+
+class Overloaded(RpcError):
+    """The destination shed the request at admission (queue full). Carries a
+    retry-after hint; message always carries ``overloaded:`` so the verdict
+    survives the wire."""
+
+    def __init__(self, msg: str, retry_after_s: float | None = None):
+        super().__init__(msg if "overloaded:" in msg else f"overloaded: {msg}")
+        self.retry_after_s = retry_after_s
+
+
+def remote_error(msg: str, retry_after_s: float | None = None) -> RpcError:
+    """Re-type a remote error string: the server flattened the exception to
+    ``ClassName: message``; the prefixes put the type back so client-side
+    retry policy keys on it."""
+    if "deadline:" in msg:
+        return DeadlineExceeded(msg)
+    if "overloaded:" in msg:
+        return Overloaded(msg, retry_after_s=retry_after_s)
+    return RpcError(msg)
+
+
+def _now() -> float:
+    # The real-IO fabric's clock seam. The Sim fabric never calls this — it
+    # runs on its own virtual clock (SimRpcNetwork.now).
+    return monotonic()  # dmlc-lint: disable=D1 -- TCP fabric phase deadlines are genuinely wall-time
+
+
+class Rpc:
+    """Client interface: synchronous call to a named method at an address.
+
+    ``timeout`` is this hop's ceiling; ``deadline`` (a Deadline or plain
+    seconds-remaining) caps it further, as does any ambient deadline bound
+    by an enclosing serving scope."""
+
+    def call(
+        self,
+        addr: str,
+        method: str,
+        payload: dict,
+        timeout: float = 60.0,
+        deadline=None,
+    ) -> dict:
         raise NotImplementedError
 
 
@@ -57,19 +116,56 @@ def _dispatch(methods: dict[str, Method], method: str, payload: dict) -> dict:
     return fn(payload)
 
 
+def serve_with_deadline(
+    methods: dict[str, Method],
+    method: str,
+    payload: dict,
+    budget_s: float | None,
+    clock: Callable[[], float],
+) -> dict:
+    """Server-side dispatch under the caller's propagated budget: refuse
+    work that arrives already expired, bind the deadline ambiently so
+    nested calls inherit it, and refuse to *return* a result the caller has
+    already given up on (the reply would be dead bytes; the caller must see
+    the same verdict its own clock reached)."""
+    if budget_s is None:
+        return _dispatch(methods, method, payload)
+    budget_s = float(budget_s)
+    if budget_s <= 0:
+        raise DeadlineExceeded(f"{method}: budget exhausted on arrival")
+    dl = deadline_mod.Deadline(budget_s, clock=clock)
+    with deadline_mod.bind(dl):
+        reply = _dispatch(methods, method, payload)
+    if dl.expired():
+        raise DeadlineExceeded(
+            f"{method}: finished {-dl.remaining():.3f}s past its "
+            f"{budget_s:.3f}s deadline"
+        )
+    return reply
+
+
 class SimRpcNetwork(Rpc):
     """Deterministic in-process RPC fabric.
 
     Services register under string addresses; calls dispatch synchronously on
     the caller's stack. Crashed or partitioned destinations raise
     ``RpcUnreachable`` exactly like a dead TCP peer would.
-    """
+
+    Time is VIRTUAL: ``now`` advances only through scripted per-link latency
+    (``set_latency``) or explicit test advancement (``advance``), so
+    timeout/deadline/breaker behavior replays deterministically. A call
+    whose link latency meets or exceeds its budget times out (``now``
+    advances by the full budget — the caller really waited that long) and
+    the method never runs; otherwise the latency is charged against the
+    propagated deadline before dispatch, exactly like wire transit."""
 
     def __init__(self):
         self.services: dict[str, dict[str, Method]] = {}
         self.down: set[str] = set()
         self.cut: set[tuple[str, str]] = set()
         self.calls: list[tuple[str, str]] = []  # (addr, method) trace for tests
+        self.now = 0.0                          # virtual clock (seconds)
+        self.latency: dict[tuple[str, str], float] = {}  # (src, dst) -> s
 
     def serve(self, addr: str, methods: dict[str, Method]) -> None:
         self.services[addr] = methods
@@ -88,17 +184,58 @@ class SimRpcNetwork(Rpc):
         self.cut.discard((a, b))
         self.cut.discard((b, a))
 
+    def set_latency(self, src: str, dst: str, seconds: float) -> None:
+        """Script one direction's transit latency (0 restores instant)."""
+        if seconds <= 0:
+            self.latency.pop((src, dst), None)
+        else:
+            self.latency[(src, dst)] = float(seconds)
+
+    def advance(self, seconds: float) -> None:
+        """Advance the virtual clock (tests model think-time/idleness)."""
+        if seconds < 0:
+            raise ValueError("time goes forward")
+        self.now += seconds
+
+    def clock(self) -> float:
+        """The virtual clock as a callable-friendly read (pass
+        ``net.clock`` wherever a monotonic timer is injected)."""
+        return self.now
+
     def client(self, source: str) -> "SimRpcClient":
         return SimRpcClient(self, source)
 
-    def _call_from(self, source: str, addr: str, method: str, payload: dict) -> dict:
+    def _call_from(
+        self,
+        source: str,
+        addr: str,
+        method: str,
+        payload: dict,
+        timeout: float = 60.0,
+        deadline=None,
+    ) -> dict:
         self.calls.append((addr, method))
+        budget = deadline_mod.resolve_budget(timeout, deadline)
+        if budget <= 0:
+            raise DeadlineExceeded(f"{addr}/{method}: no budget remaining before dialing")
         if source in self.down:
             raise RpcUnreachable(f"{source} is down")
         if addr in self.down or addr not in self.services or (source, addr) in self.cut:
             raise RpcUnreachable(f"{addr} unreachable from {source}")
+        lat = self.latency.get((source, addr), 0.0)
+        if lat >= budget:
+            # The caller waits out its whole budget before giving up; the
+            # frame is still in flight, so the method never executes here
+            # (the deterministic reading of "the reply came too late").
+            self.now += budget
+            raise RpcUnreachable(
+                f"{addr}: no reply within {budget:.3f}s (link latency {lat:.3f}s)"
+            )
+        self.now += lat
         try:
-            return _dispatch(self.services[addr], method, payload)
+            return serve_with_deadline(
+                self.services[addr], method, payload, budget - lat, clock=self.clock
+            )
         except RpcError:
             raise
         except Exception as e:
@@ -113,8 +250,17 @@ class SimRpcClient(Rpc):
         self.network = network
         self.source = source
 
-    def call(self, addr: str, method: str, payload: dict, timeout: float = 60.0) -> dict:
-        return self.network._call_from(self.source, addr, method, payload)
+    def call(
+        self,
+        addr: str,
+        method: str,
+        payload: dict,
+        timeout: float = 60.0,
+        deadline=None,
+    ) -> dict:
+        return self.network._call_from(
+            self.source, addr, method, payload, timeout=timeout, deadline=deadline
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -170,7 +316,12 @@ def _recv_exact(sock: socket.socket, n: int) -> bytearray:
 
 
 class TcpRpcServer:
-    """Threaded TCP server hosting one method table."""
+    """Threaded TCP server hosting one method table.
+
+    ``metrics`` (utils/metrics.Counters, optional) counts the
+    ``deadline_exceeded`` verdicts this server hands out (budget ran out on
+    arrival or during execution); sheds are counted by the admission gates
+    that raise them."""
 
     def __init__(
         self,
@@ -178,9 +329,11 @@ class TcpRpcServer:
         port: int,
         methods: dict[str, Method],
         auth: FrameAuth | None = None,
+        metrics=None,
     ):
         self.methods = methods
         self.auth = auth
+        self.metrics = metrics
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind((host, port))
@@ -205,6 +358,13 @@ class TcpRpcServer:
                 return
             threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
 
+    def _count(self, e: Exception) -> None:
+        # Sheds are counted by the admission gates themselves (the same
+        # Counters instance) — counting Overloaded here again would double
+        # every shed. Deadline verdicts have no other server-side counter.
+        if self.metrics is not None and isinstance(e, DeadlineExceeded):
+            self.metrics.inc("deadline_exceeded")
+
     def _serve_conn(self, conn: socket.socket) -> None:
         with conn:
             try:
@@ -213,15 +373,16 @@ class TcpRpcServer:
                     # Replies are sealed for the AUTHENTICATED requester id,
                     # so a recorded reply cannot be replayed to anyone else.
                     try:
-                        reply = _dispatch(self.methods, req["m"], req["p"])
+                        reply = serve_with_deadline(
+                            self.methods, req["m"], req["p"], req.get("d"), clock=_now
+                        )
                         _send_frame(conn, {"ok": True, "r": reply}, self.auth, recipient=peer)
                     except Exception as e:  # method error -> remote RpcError
-                        _send_frame(
-                            conn,
-                            {"ok": False, "e": f"{type(e).__name__}: {e}"},
-                            self.auth,
-                            recipient=peer,
-                        )
+                        self._count(e)
+                        err: dict = {"ok": False, "e": f"{type(e).__name__}: {e}"}
+                        if isinstance(e, Overloaded) and e.retry_after_s is not None:
+                            err["retry_after"] = float(e.retry_after_s)
+                        _send_frame(conn, err, self.auth, recipient=peer)
             except (RpcUnreachable, OSError):
                 return  # client went away
             except AuthError as e:
@@ -255,17 +416,51 @@ class TcpRpc(Rpc):
     callers must dial members by their canonical ``config.host:port``
     strings (the ones membership gossips), not an alias ('localhost', a DNS
     name, a second NIC). Every in-tree caller gets addresses from
-    membership/config, which satisfies this by construction."""
+    membership/config, which satisfies this by construction.
+
+    The call's budget is spent ONCE across the connect, send, and recv
+    phases: each phase's socket timeout is the time *remaining* from a
+    monotonic start, so a slow connect plus a slow reply can never stretch
+    one call to ~2x the stated bound."""
 
     def __init__(self, auth: FrameAuth | None = None):
         self.auth = auth
 
-    def call(self, addr: str, method: str, payload: dict, timeout: float = 60.0) -> dict:
+    def call(
+        self,
+        addr: str,
+        method: str,
+        payload: dict,
+        timeout: float = 60.0,
+        deadline=None,
+    ) -> dict:
+        budget = deadline_mod.resolve_budget(timeout, deadline)
+        if budget <= 0:
+            raise DeadlineExceeded(f"{addr}/{method}: no budget remaining before dialing")
         host, _, port = addr.rpartition(":")
+        start = _now()
+
+        def remaining() -> float:
+            return budget - (_now() - start)
+
         try:
-            with socket.create_connection((host, int(port)), timeout=timeout) as sock:
-                sock.settimeout(timeout)
-                _send_frame(sock, {"m": method, "p": payload}, self.auth, recipient=addr)
+            with socket.create_connection((host, int(port)), timeout=budget) as sock:
+                left = remaining()
+                if left <= 0:
+                    raise RpcUnreachable(f"{addr}: connect consumed the whole budget")
+                sock.settimeout(left)
+                # The server's budget is what remains NOW, not the original
+                # timeout — the connect phase already spent its share.
+                _send_frame(
+                    sock,
+                    {"m": method, "p": payload, "d": left},
+                    self.auth,
+                    recipient=addr,
+                )
+                left = remaining()
+                if left <= 0:
+                    raise RpcUnreachable(f"{addr}: budget exhausted before the reply")
+                sock.settimeout(left)
                 # Replies are authenticated too: a spoofed leader cannot feed
                 # a keyed member forged directory state.
                 reply, _ = _recv_frame(sock, self.auth)
@@ -276,5 +471,7 @@ class TcpRpc(Rpc):
         except (OSError, ValueError) as e:
             raise RpcUnreachable(f"{addr}: {e}") from e
         if not reply.get("ok"):
-            raise RpcError(reply.get("e", "remote error"))
+            raise remote_error(
+                reply.get("e", "remote error"), retry_after_s=reply.get("retry_after")
+            )
         return reply["r"]

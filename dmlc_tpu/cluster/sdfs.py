@@ -33,6 +33,7 @@ import uuid
 from dataclasses import dataclass, field
 from pathlib import Path
 
+from dmlc_tpu.cluster import deadline as deadline_lib
 from dmlc_tpu.cluster import diskio
 from dmlc_tpu.cluster.diskio import DiskIo, atomic_copy, atomic_install, atomic_write
 from dmlc_tpu.cluster.rpc import Rpc, RpcError, RpcUnreachable
@@ -377,10 +378,27 @@ class SdfsMember:
     RPC fabric, preserving its O(chunk) memory property.
     """
 
-    def __init__(self, store: MemberStore, rpc: Rpc, chunk_bytes: int = DEFAULT_CHUNK_BYTES):
+    def __init__(
+        self,
+        store: MemberStore,
+        rpc: Rpc,
+        chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+        transfer_timeout_s: float = 300.0,
+        gate=None,
+    ):
         self.store = store
         self.rpc = rpc
         self.chunk_bytes = chunk_bytes
+        # Whole-transfer ceiling for replicate pulls; an inbound deadline on
+        # the replicate RPC caps it further (deadlines are inherited).
+        self.transfer_timeout_s = float(transfer_timeout_s)
+        # Admission gate for the bulk byte-movers (fetch/chunk/replicate):
+        # past max_inflight + max_queue concurrent transfers the request is
+        # shed with a typed Overloaded instead of piling onto this node's
+        # disk/NIC until everything misses its deadline. Control verbs
+        # (meta, store, scrub, fence) are never gated — they are how the
+        # fleet *observes* an overloaded member.
+        self.gate = gate
         # Highest leadership epoch seen on any write (failover.epoch_key
         # order): writes carrying an OLDER term are rejected — a stale
         # claimant on the wrong side of a candidate partition cannot land
@@ -392,6 +410,11 @@ class SdfsMember:
         self._fence_path = store.dir.parent / (store.dir.name + ".fence")
         self._fence: tuple[int, str] | None = self._load_fence()
         self._fence_lock = threading.Lock()
+
+    def _admit(self):
+        from contextlib import nullcontext
+
+        return nullcontext() if self.gate is None else self.gate.admit()
 
     def _load_fence(self) -> tuple[int, str] | None:
         try:
@@ -465,7 +488,8 @@ class SdfsMember:
 
     def _fetch(self, p: dict) -> dict:
         try:
-            return {"data": self.store.read(p["name"], int(p["version"]))}
+            with self._admit():
+                return {"data": self.store.read(p["name"], int(p["version"]))}
         except KeyError as e:
             raise RpcError(str(e))
 
@@ -477,11 +501,12 @@ class SdfsMember:
 
     def _fetch_chunk(self, p: dict) -> dict:
         try:
-            return {
-                "data": self.store.read_range(
-                    p["name"], int(p["version"]), int(p["offset"]), int(p["length"])
-                )
-            }
+            with self._admit():
+                return {
+                    "data": self.store.read_range(
+                        p["name"], int(p["version"]), int(p["offset"]), int(p["length"])
+                    )
+                }
         except KeyError as e:
             raise RpcError(str(e))
 
@@ -493,11 +518,12 @@ class SdfsMember:
 
     def _fetch_stage_chunk(self, p: dict) -> dict:
         try:
-            return {
-                "data": self.store.staged_range(
-                    p["name"], int(p["offset"]), int(p["length"])
-                )
-            }
+            with self._admit():
+                return {
+                    "data": self.store.staged_range(
+                        p["name"], int(p["offset"]), int(p["length"])
+                    )
+                }
         except KeyError as e:
             raise RpcError(str(e))
 
@@ -509,6 +535,10 @@ class SdfsMember:
         digest before install — a corrupt source (or wire) can fail this
         pull, but can never seed a corrupt replica here."""
         self._check_epoch(p)
+        with self._admit():
+            return self._replicate_admitted(p)
+
+    def _replicate_admitted(self, p: dict) -> dict:
         name, version, source = p["name"], int(p["version"]), p["source"]
         digest = p.get("digest")
         if p.get("from_stage"):
@@ -518,9 +548,21 @@ class SdfsMember:
         else:
             meta, chunk = "sdfs.fetch_meta", "sdfs.fetch_chunk"
             ident = {"name": name, "version": version}
-        size = int(self.rpc.call(source, meta, ident)["size"])
+        # One transfer budget covers the whole pull (meta + every chunk):
+        # the per-hop Deadline shrinks as chunks land, and the caller's own
+        # propagated deadline (if tighter) is inherited underneath it.
+        transfer = deadline_lib.Deadline(self.transfer_timeout_s)
+        size = int(
+            self.rpc.call(source, meta, ident, timeout=30.0, deadline=transfer)["size"]
+        )
         if size <= self.chunk_bytes:
-            data = self.rpc.call(source, chunk, {**ident, "offset": 0, "length": size})["data"]
+            data = self.rpc.call(
+                source,
+                chunk,
+                {**ident, "offset": 0, "length": size},
+                timeout=self.transfer_timeout_s,
+                deadline=transfer,
+            )["data"]
             self.store.receive(name, version, data, digest=digest)
             return {}
         scratch = self.store.incoming_path()
@@ -534,6 +576,8 @@ class SdfsMember:
                         chunk,
                         {**ident, "offset": offset,
                          "length": min(self.chunk_bytes, size - offset)},
+                        timeout=self.transfer_timeout_s,
+                        deadline=transfer,
                     )["data"]
                     f.write(part)
             if scratch.stat().st_size != size:
@@ -634,10 +678,14 @@ class SdfsLeader:
         replication_factor: int = 4,
         is_leading: bool = True,
         fanout: int = 4,
+        transfer_timeout_s: float = 300.0,
     ):
         self.rpc = rpc
         self.active_members = active_members
         self.rf = replication_factor
+        # Ceiling for one replica copy (the member pulls chunk-by-chunk
+        # under this budget, which the RPC frame propagates to it).
+        self.transfer_timeout_s = float(transfer_timeout_s)
         # Concurrent replica copies per placement (the reference ran its scp
         # fanout 10-wide, services.rs:367-373); 1 = fully sequential.
         self.fanout = max(1, fanout)
@@ -948,7 +996,10 @@ class SdfsLeader:
         failed = []
         for m in members:
             try:
-                self.rpc.call(m, "sdfs.delete", {"name": name, "epoch": list(self.epoch)})
+                self.rpc.call(
+                    m, "sdfs.delete", {"name": name, "epoch": list(self.epoch)},
+                    timeout=10.0,
+                )
             except (RpcUnreachable, RpcError):
                 # Tolerated: stores persist across restarts now, but the
                 # tombstone keeps the blob out of the directory and the
@@ -1003,6 +1054,7 @@ class SdfsLeader:
                         "sdfs.receive",
                         {"name": name, "version": version, "data": data,
                          "digest": digest, "epoch": list(self.epoch)},
+                        timeout=self.transfer_timeout_s,
                     )
                 else:
                     self.rpc.call(
@@ -1017,6 +1069,7 @@ class SdfsLeader:
                             "digest": digest,
                             "epoch": list(self.epoch),
                         },
+                        timeout=self.transfer_timeout_s,
                     )
                 return True
             except (RpcUnreachable, RpcError) as e:
@@ -1113,7 +1166,13 @@ class SdfsLeader:
 class SdfsClient:
     """Client verbs against a leader + the member fabric. ``self_addr`` is
     this node's member RPC address (the staging origin for puts). Bulk bytes
-    stream disk-to-disk in bounded chunks at every hop."""
+    stream disk-to-disk in bounded chunks at every hop.
+
+    ``retry_policy`` (cluster/retrypolicy.py, optional) governs the replica
+    fallback walk in ``_pull_to``: the first replica is a free attempt,
+    every FURTHER replica is a retry that must pass that member's breaker
+    and spend a retry token — a fleet of clients falling back through the
+    same drowning replica no longer multiplies its load."""
 
     def __init__(
         self,
@@ -1122,12 +1181,18 @@ class SdfsClient:
         store: MemberStore,
         self_addr: str,
         chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+        timeout_s: float = 60.0,
+        transfer_timeout_s: float = 300.0,
+        retry_policy=None,
     ):
         self.rpc = rpc
         self.leader_addr = leader_addr
         self.local_store = store
         self.self_addr = self_addr
         self.chunk_bytes = chunk_bytes
+        self.timeout_s = float(timeout_s)            # control verbs
+        self.transfer_timeout_s = float(transfer_timeout_s)  # bulk pulls
+        self.retry_policy = retry_policy
 
     def put(self, local_path: str | Path, name: str) -> dict:
         # Streaming-copy the file into the stage area — the blob never
@@ -1151,13 +1216,17 @@ class SdfsClient:
                 "sdfs.put",
                 {"name": name, "origin": self.self_addr, "stage_key": key,
                  "digest": digest},
+                # The leader fans the placement out to rf replicas; give the
+                # whole put one transfer-class budget.
+                timeout=self.transfer_timeout_s,
             )
         finally:
             self.local_store.unstage(key)
 
     def get(self, name: str, local_path: str | Path, version: int | None = None) -> int:
         info = self.rpc.call(
-            self.leader_addr, "sdfs.get", {"name": name, "version": version}
+            self.leader_addr, "sdfs.get", {"name": name, "version": version},
+            timeout=self.timeout_s,
         )
         self._pull_to_path(local_path, lambda f: self._pull_to(
             name, info["version"], info["replicas"], f, digest=info.get("digest")
@@ -1168,7 +1237,8 @@ class SdfsClient:
         import io
 
         info = self.rpc.call(
-            self.leader_addr, "sdfs.get", {"name": name, "version": version}
+            self.leader_addr, "sdfs.get", {"name": name, "version": version},
+            timeout=self.timeout_s,
         )
         buf = io.BytesIO()
         self._pull_to(
@@ -1179,7 +1249,10 @@ class SdfsClient:
     def get_versions(self, name: str, n: int, local_path: str | Path) -> list[int]:
         """Fetch the last n versions merged newest-first into one file with
         '== Version N ==' delimiters (services.rs:555-569)."""
-        reply = self.rpc.call(self.leader_addr, "sdfs.get_versions", {"name": name, "n": n})
+        reply = self.rpc.call(
+            self.leader_addr, "sdfs.get_versions", {"name": name, "n": n},
+            timeout=self.timeout_s,
+        )
         digests = reply.get("digests", {})
         versions: list[int] = []
 
@@ -1213,20 +1286,27 @@ class SdfsClient:
             raise
 
     def delete(self, name: str) -> dict:
-        return self.rpc.call(self.leader_addr, "sdfs.delete", {"name": name})
+        return self.rpc.call(
+            self.leader_addr, "sdfs.delete", {"name": name}, timeout=self.timeout_s
+        )
 
     def ls(self, name: str | None = None) -> dict:
-        return self.rpc.call(self.leader_addr, "sdfs.ls", {"name": name})["files"]
+        return self.rpc.call(
+            self.leader_addr, "sdfs.ls", {"name": name}, timeout=self.timeout_s
+        )["files"]
 
     def store(self, member_addr: str | None = None) -> dict:
         addr = member_addr or self.self_addr
-        return self.rpc.call(addr, "sdfs.store", {})["files"]
+        return self.rpc.call(addr, "sdfs.store", {}, timeout=self.timeout_s)["files"]
 
     def scrub(self, member_addr: str | None = None, max_blobs: int | None = None) -> dict:
         """Trigger one anti-entropy scrub pass on a member (default: this
-        node). Returns {scanned, corrupt}."""
+        node). Returns {scanned, corrupt}. A full-store scrub re-hashes
+        every blob, so it rides the transfer-class budget."""
         addr = member_addr or self.self_addr
-        return self.rpc.call(addr, "sdfs.scrub", {"max": max_blobs})
+        return self.rpc.call(
+            addr, "sdfs.scrub", {"max": max_blobs}, timeout=self.transfer_timeout_s
+        )
 
     def report_corrupt(self, name: str, version: int, member: str) -> None:
         """Tell the leader a replica failed verification (best-effort: a
@@ -1237,6 +1317,7 @@ class SdfsClient:
                 self.leader_addr,
                 "sdfs.report_corrupt",
                 {"name": name, "version": version, "member": member},
+                timeout=self.timeout_s,
             )
         except (RpcUnreachable, RpcError) as e:
             log.warning("could not report corrupt %s v%s at %s: %s", name, version, member, e)
@@ -1251,11 +1332,26 @@ class SdfsClient:
         never reaches the caller."""
         last: Exception | None = None
         start = f.tell()
-        for r in replicas:
+        for i, r in enumerate(replicas):
+            # Retry governance: replica 0 is the free first attempt; every
+            # fallback is a retry — breaker-gated and budgeted per replica,
+            # so a drowning member is skipped instead of hammered.
+            if self.retry_policy is not None:
+                allowed = (
+                    self.retry_policy.allow(r) if i == 0
+                    else self.retry_policy.allow_retry(r)
+                )
+                if not allowed:
+                    last = RpcUnreachable(f"{r}: skipped (breaker open / retry budget dry)")
+                    continue
             hasher = hashlib.sha256()
+            transfer = deadline_lib.Deadline(self.transfer_timeout_s)
             try:
                 size = int(
-                    self.rpc.call(r, "sdfs.fetch_meta", {"name": name, "version": version})["size"]
+                    self.rpc.call(
+                        r, "sdfs.fetch_meta", {"name": name, "version": version},
+                        timeout=30.0, deadline=transfer,
+                    )["size"]
                 )
                 f.seek(start)
                 f.truncate(start)
@@ -1269,6 +1365,8 @@ class SdfsClient:
                             "offset": offset,
                             "length": min(self.chunk_bytes, size - offset),
                         },
+                        timeout=self.transfer_timeout_s,
+                        deadline=transfer,
                     )["data"]
                     hasher.update(part)
                     f.write(part)
@@ -1277,8 +1375,12 @@ class SdfsClient:
                         f"replica {r} served {name} v{version} with digest "
                         f"{hasher.hexdigest()[:12]} != expected {digest[:12]}"
                     )
+                if self.retry_policy is not None:
+                    self.retry_policy.record(r)
                 return
             except (RpcUnreachable, RpcError) as e:
+                if self.retry_policy is not None:
+                    self.retry_policy.record(r, e)
                 if is_integrity_error(e):
                     # Either we hashed a mismatch, or the member's own read
                     # verification tripped — in both cases that copy is rot.

@@ -37,9 +37,15 @@ import threading
 from dataclasses import dataclass, field
 from time import monotonic
 
-from dmlc_tpu.cluster.rpc import Rpc, RpcError, RpcUnreachable
+from dmlc_tpu.cluster.rpc import (
+    DeadlineExceeded,
+    Overloaded,
+    Rpc,
+    RpcError,
+    RpcUnreachable,
+)
 from dmlc_tpu.scheduler.worker import gang_slice
-from dmlc_tpu.utils.metrics import LatencyStats
+from dmlc_tpu.utils.metrics import Counters, LatencyStats
 from dmlc_tpu.utils.tracing import tracer
 
 log = logging.getLogger(__name__)
@@ -200,6 +206,11 @@ class JobScheduler:
         member_weight=None,
         hedge_tail: bool = True,
         mesh_group=None,
+        retry_policy=None,
+        gray_factor: float = 0.0,
+        gray_min_latency_s: float = 0.25,
+        gray_probe_interval_s: float = 5.0,
+        metrics: Counters | None = None,
     ):
         import time
 
@@ -208,6 +219,27 @@ class JobScheduler:
         self.shard_size = int(shard_size)
         self.timer = timer or time.perf_counter
         self.shard_timeout_s = float(shard_timeout_s)
+        # Overload control (docs/OVERLOAD.md): the node-shared retry
+        # governor (cluster/retrypolicy.py) — dispatch consults the
+        # per-member breaker before every RPC and spends a retry token for
+        # every requeued shard re-dispatch, so a dead or drowning member
+        # costs bounded probe traffic instead of a retry storm. None (the
+        # sim-test default) disables gating entirely.
+        self.retry_policy = retry_policy
+        # Gray-failure ejection: a member whose EWMA shard latency exceeds
+        # gray_factor x the fleet median (and the absolute floor), or whose
+        # breaker keeps reopening, is demoted — no new shards, one canary
+        # shard per probe interval — and restored when it recovers.
+        # Crashes already requeue; this catches slow-but-alive members
+        # membership cannot see. 0 disables.
+        self.gray_factor = float(gray_factor)
+        self.gray_min_latency_s = float(gray_min_latency_s)
+        self.gray_probe_interval_s = float(gray_probe_interval_s)
+        self.metrics = metrics if metrics is not None else Counters()
+        # member addr -> {"ewma", "demoted", "reason", "last_probe",
+        # "opens_mark"} (leader-local; a new leader re-learns the fleet).
+        self._health: dict[str, dict] = {}
+        self.demoted: set[str] = set()
         # Tail hedging (backup requests): once a job has no fresh shards to
         # reserve, idle dispatchers re-send the oldest still-outstanding
         # shard to a DIFFERENT member instead of sleeping — one straggler
@@ -266,8 +298,32 @@ class JobScheduler:
             "job.state": self._state,
             "job.assignments": self._assignments,
             "leader.alive": lambda p: {"ok": True},
-            "leader.status": lambda p: {"leading": self.is_leading, "epoch": list(self.epoch)},
+            "leader.status": lambda p: {
+                "leading": self.is_leading,
+                "epoch": list(self.epoch),
+                "overload": self.overload_status(),
+            },
         }
+
+    def overload_status(self) -> dict:
+        """The overload-control counters and verdicts this leader holds —
+        rides ``leader.status`` so the CLI ``status`` verb (and standbys)
+        can show shed/deadline/breaker/demotion state fleet-wide."""
+        with self._lock:
+            health = {
+                m: {"ewma_s": h["ewma"], "demoted": h["demoted"], "reason": h["reason"]}
+                for m, h in self._health.items()
+                if h["ewma"] is not None or h["demoted"]
+            }
+            demoted = sorted(self.demoted)
+        out: dict = {
+            "counters": self.metrics.snapshot(),
+            "demoted": demoted,
+            "member_health": health,
+        }
+        if self.retry_policy is not None:
+            out["breakers"] = self.retry_policy.snapshot()
+        return out
 
     def _start_rpc(self, p: dict) -> dict:
         """RPC guard: only the active leader accepts `predict` — a deferring
@@ -316,11 +372,21 @@ class JobScheduler:
         With a registered mesh group, every running job is instead assigned
         the WHOLE group: the mesh is one collective serving unit (its
         backends jit over the global mesh and cannot answer per-member
-        shards), and jobs share it serially through the gang lock."""
+        shards), and jobs share it serially through the gang lock.
+
+        Gray-demoted members are excluded from assignment (the quarantine
+        tier: no new shards, canary probes only via next_shard) — unless
+        every member is demoted, in which case availability wins and the
+        full fleet serves. Gang mode ignores demotion: the collective needs
+        every rank."""
         group = self.mesh_group() if self.mesh_group is not None else None
         members = sorted(self.active_members())
         weights = {m: max(1, int(self.member_weight(m))) for m in members}
         with self._lock:
+            self._gray_check()
+            if not group and self.demoted:
+                kept = [m for m in members if m not in self.demoted]
+                members = kept or members
             running = [n for n, j in self.jobs.items() if j.running and not j.done]
             for name, job in self.jobs.items():
                 if name not in running:
@@ -343,6 +409,105 @@ class JobScheduler:
                 for r in range(max((weights[m] for m in job.assigned), default=0)):
                     pool.extend(m for m in job.assigned if weights[m] > r)
                 job.dispatch_pool = pool
+
+    # ---- gray-failure ejection (docs/OVERLOAD.md) ----------------------
+
+    GRAY_ALPHA = 0.3  # EWMA smoothing for per-member shard latency
+
+    def _observe_member(self, member: str, elapsed: float, failure: bool = False) -> dict:
+        """Fold one dispatch's latency into the member's EWMA. Caller holds
+        the lock. Success latencies always count; a FAILURE's elapsed time
+        counts only when it is evidence of slowness (>= the current EWMA) —
+        an instantly-unreachable member must not wash its slow history
+        clean (that is the breaker's case, not gray's)."""
+        h = self._health.get(member)
+        if h is None:
+            h = self._health[member] = {
+                "ewma": None, "demoted": False, "reason": "",
+                "last_probe": 0.0, "opens_mark": 0,
+            }
+        if failure and (h["ewma"] is None or elapsed < h["ewma"]):
+            return h
+        if h["ewma"] is None:
+            h["ewma"] = float(elapsed)
+        else:
+            h["ewma"] = (1 - self.GRAY_ALPHA) * h["ewma"] + self.GRAY_ALPHA * elapsed
+        return h
+
+    def _demote(self, member: str, reason: str, detail: str) -> None:
+        h = self._health[member]
+        h["demoted"] = True
+        h["reason"] = reason
+        h["last_probe"] = self.timer()  # first canary waits one interval
+        self.demoted.add(member)
+        self.metrics.inc("gray_demotions")
+        tracer.record("overload/gray_demote", 0.0, member=member, reason=reason)
+        log.warning("gray-demoting %s: %s", member, detail)
+
+    def _restore(self, member: str) -> None:
+        h = self._health[member]
+        h["demoted"] = False
+        h["reason"] = ""
+        if self.retry_policy is not None:
+            h["opens_mark"] = self.retry_policy.open_count(member)
+        self.demoted.discard(member)
+        self.metrics.inc("gray_restored")
+        tracer.record("overload/gray_restore", 0.0, member=member)
+        log.warning("gray-restoring %s: recovered", member)
+
+    def _gray_check(self) -> None:
+        """One demotion/restoration pass (caller holds the lock; runs every
+        assignment tick). Latency rule: EWMA > max(gray_factor x fleet
+        median, the absolute floor) demotes; recovery below 0.7x that
+        threshold restores (hysteresis, so a member hovering at the line
+        does not flap). Breaker rule: >= 2 re-opens since the last mark
+        demotes; a breaker observed closed again (a half-open canary
+        succeeded) restores."""
+        if self.gray_factor <= 0:
+            return
+        if self.retry_policy is not None:
+            for m, h in self._health.items():
+                opens = self.retry_policy.open_count(m)
+                if not h["demoted"] and opens - h["opens_mark"] >= 2:
+                    self._demote(m, "breaker", f"breaker re-opened {opens - h['opens_mark']}x")
+                elif (
+                    h["demoted"]
+                    and h["reason"] == "breaker"
+                    and self.retry_policy.breaker_state(m) == "closed"
+                ):
+                    self._restore(m)
+        ewmas = {m: h["ewma"] for m, h in self._health.items() if h["ewma"] is not None}
+        active = sorted(v for m, v in ewmas.items() if not self._health[m]["demoted"])
+        if len(active) < 2:
+            return  # no fleet to be an outlier OF
+        median = active[len(active) // 2]
+        threshold = max(self.gray_factor * median, self.gray_min_latency_s)
+        for m, v in ewmas.items():
+            h = self._health[m]
+            if not h["demoted"] and v > threshold:
+                self._demote(m, "slow", f"ewma {v:.3f}s > {threshold:.3f}s "
+                                        f"(fleet median {median:.3f}s)")
+            elif h["demoted"] and h["reason"] == "slow" and v <= 0.7 * threshold:
+                self._restore(m)
+
+    def _gray_probe_candidate(self, excluded: set) -> str | None:
+        """A demoted member due for its canary shard, or None. Caller holds
+        the lock. The canary is a REAL shard: if the member is still slow
+        the shard times out and requeues (exactly-once bookkeeping
+        unaffected); if it answers, the latency feeds the EWMA that will
+        restore it."""
+        if not self.demoted:
+            return None
+        now = self.timer()
+        for m in sorted(self.demoted):
+            h = self._health[m]
+            if m in excluded or now - h["last_probe"] < self.gray_probe_interval_s:
+                continue
+            if self.retry_policy is not None and not self.retry_policy.allow(m):
+                continue
+            h["last_probe"] = now
+            return m
+        return None
 
     # ---- dispatch (services.rs:407-433, shard-ized) --------------------
 
@@ -383,8 +548,10 @@ class JobScheduler:
                 return None
             excluded: set = set()
             hedge = False
+            is_retry = False
             if job.retry_q:
                 offset, excluded = job.retry_q.pop(0)
+                is_retry = True
             elif job.next_offset < len(job.queries):
                 offset = job.next_offset
                 job.next_offset += self.shard_size
@@ -404,11 +571,46 @@ class JobScheduler:
                 if hedge:
                     return None  # nobody fresh to back it up with
                 pool = base
-            member = pool[job._next_member % len(pool)]
-            job._next_member += 1
+            member = None
+            if not hedge:
+                # Gray canary FIRST: a demoted member due for its probe takes
+                # this shard — the only way quarantined members receive work,
+                # and the evidence stream that restores them. Checked before
+                # the normal pick so no half-open breaker slot is claimed for
+                # a member the canary would then displace (a claimed-but-
+                # never-dispatched probe slot wedges that peer shut).
+                member = self._gray_probe_candidate(excluded)
+            if member is None:
+                for _ in range(len(pool)):
+                    cand = pool[job._next_member % len(pool)]
+                    job._next_member += 1
+                    if self._policy_allows(cand, is_retry):
+                        member = cand
+                        break
+            if member is None:
+                # Every candidate denied (breaker open / retry budget dry):
+                # put the reservation back and let the dispatcher back off —
+                # a denied retry fast-fails locally instead of spinning RPCs
+                # at a peer that is down or drowning.
+                if is_retry:
+                    job.retry_q.insert(0, (offset, excluded))
+                elif not hedge:
+                    job.next_offset = offset
+                return None
             job.outstanding.setdefault(offset, set()).add(member)
             job.dispatch_t.setdefault(offset, self.timer())
             return member, offset, shard, excluded
+
+    def _policy_allows(self, member: str, is_retry: bool) -> bool:
+        """Breaker gate for every pick; breaker + retry-token for requeued
+        work (hedges are already bounded to 2 copies, so they spend no
+        tokens). Caller holds the scheduler lock; the policy's own lock is
+        a leaf."""
+        if self.retry_policy is None:
+            return True
+        if is_retry:
+            return self.retry_policy.allow_retry(member)
+        return self.retry_policy.allow(member)
 
     def _gang_group(self, job: Job):
         """(group, ok): group is {addr: rank} when the global mesh is fully
@@ -666,9 +868,21 @@ class JobScheduler:
             if len(preds) != len(shard):
                 raise RpcError(f"{len(preds)} predictions for {len(shard)} queries")
         except (RpcUnreachable, RpcError) as e:
+            if self.retry_policy is not None:
+                self.retry_policy.record(member, e)
+            if isinstance(e, DeadlineExceeded):
+                self.metrics.inc("deadline_exceeded")
+            elif isinstance(e, Overloaded):
+                self.metrics.inc("shed_observed")
+            with self._lock:
+                # A timeout/deadline failure IS slowness evidence for gray
+                # ejection (fast unreachable errors are filtered inside).
+                self._observe_member(member, self.timer() - t0, failure=True)
             log.warning("shard dispatch %s[%d] -> %s failed: %s", job_name, offset, member, e)
             self._record_failure(job, offset, member, excluded)
             return 0
+        if self.retry_policy is not None:
+            self.retry_policy.record(member)
         elapsed = self.timer() - t0
         return self._record_result(job, offset, shard, preds, elapsed, member)
 
@@ -705,6 +919,7 @@ class JobScheduler:
             job.last_result_t = self.timer()
             if member is not None:
                 job.member_stats.setdefault(member, LatencyStats()).record(elapsed)
+                self._observe_member(member, elapsed)
             job.buffered[offset] = (preds, elapsed)
             while job.finished in job.buffered:
                 p, dt = job.buffered.pop(job.finished)

@@ -22,7 +22,7 @@ from collections import OrderedDict
 from pathlib import Path
 from typing import Callable, Protocol, Sequence
 
-from dmlc_tpu.cluster.rpc import RpcError
+from dmlc_tpu.cluster.rpc import Overloaded, RpcError
 from dmlc_tpu.utils.hotpath import hot_path
 from dmlc_tpu.utils.metrics import LatencyStats
 from dmlc_tpu.utils.tracing import tracer
@@ -52,6 +52,14 @@ class DynamicBatcher:
     ``predict_gang``, ...) passes through to the wrapped backend — gang
     shards are collective SPMD executions whose slicing must not be
     reordered, so they deliberately bypass the batcher.
+
+    Overload control (docs/OVERLOAD.md): with ``max_queue > 0`` the queue is
+    BOUNDED — a submit against a full queue is shed immediately with a typed
+    ``Overloaded`` (retry-after = the batch deadline) instead of buffering
+    toward a guaranteed timeout. And the batch deadline *brownouts*: as the
+    queue fills, the coalescing wait shrinks linearly to zero — waiting
+    optimizes latency the batcher no longer has, so under pressure it
+    degrades to dispatch-as-fast-as-the-device-drains.
     """
 
     def __init__(
@@ -60,6 +68,8 @@ class DynamicBatcher:
         batch_size: int,
         max_wait_s: float = 0.005,
         name: str = "microbatch",
+        max_queue: int = 0,
+        metrics=None,
     ):
         # _predict is set FIRST: __getattr__ delegates to it, and any
         # attribute probe before it exists would recurse.
@@ -68,6 +78,11 @@ class DynamicBatcher:
         self.max_wait_s = float(max_wait_s)
         if self.batch_size <= 0:
             raise ValueError("batch_size must be positive")
+        # Bounded admission: 0 = unbounded (the pre-overload behavior). A
+        # bound below one device batch would shed work the very next
+        # dispatch could carry, so the floor is 2 full batches.
+        self.max_queue = max(2 * self.batch_size, int(max_queue)) if max_queue > 0 else 0
+        self.metrics = metrics
         # One Condition owns all batcher state; its internal lock is only
         # ever held for list surgery — the device dispatch runs outside it.
         self._cv = threading.Condition()
@@ -75,6 +90,8 @@ class DynamicBatcher:
         self._closed = False
         self.requests = 0    # items ever submitted
         self.dispatches = 0  # device-shaped batches sent to the backend
+        self.sheds = 0       # submits refused at the bounded queue
+        self.queue_hw = 0    # queue-depth high-water
         self.fill = LatencyStats()  # per-dispatch batch fill fraction
         self._thread = threading.Thread(target=self._loop, name=name, daemon=True)
         self._thread.start()
@@ -83,13 +100,27 @@ class DynamicBatcher:
 
     def submit(self, synset: str) -> "concurrent.futures.Future":
         """Queue one classify request; the future resolves to its predicted
-        class index once the batch it rides in completes."""
+        class index once the batch it rides in completes. Sheds with
+        ``Overloaded`` when the bounded queue is full."""
         fut: concurrent.futures.Future = concurrent.futures.Future()
         with self._cv:
             if self._closed:
                 raise RuntimeError("batcher is stopped")
+            if self.max_queue > 0 and len(self._queue) >= self.max_queue:
+                self.sheds += 1
+                if self.metrics is not None:
+                    self.metrics.inc("shed")
+                    self.metrics.inc("shed_microbatch")
+                raise Overloaded(
+                    f"microbatch queue full ({len(self._queue)}/{self.max_queue})",
+                    retry_after_s=self.max_wait_s,
+                )
             self._queue.append((synset, fut))
             self.requests += 1
+            if len(self._queue) > self.queue_hw:
+                self.queue_hw = len(self._queue)
+                if self.metrics is not None:
+                    self.metrics.observe_high("queue_hw_microbatch", len(self._queue))
             self._cv.notify_all()
         return fut
 
@@ -119,7 +150,12 @@ class DynamicBatcher:
                 # Deadline semantics: measured from the moment the worker
                 # sees the first queued item; the batch goes as soon as it
                 # is FULL, else when the deadline lapses (partial batch).
-                deadline = time.monotonic() + self.max_wait_s
+                # Brownout: the wait shrinks linearly with queue depth — a
+                # full bounded queue coalesces with ZERO added latency.
+                wait = self.max_wait_s
+                if self.max_queue > 0:
+                    wait *= max(0.0, 1.0 - len(self._queue) / self.max_queue)
+                deadline = time.monotonic() + wait
                 while len(self._queue) < self.batch_size and not self._closed:
                     left = deadline - time.monotonic()
                     if left <= 0:
@@ -166,6 +202,8 @@ class DynamicBatcher:
                 "requests": self.requests,
                 "dispatches": self.dispatches,
                 "mean_fill": self.fill.mean if len(self.fill) else 0.0,
+                "sheds": self.sheds,
+                "queue_hw": self.queue_hw,
             }
 
 
@@ -180,10 +218,19 @@ def _resolve_paths(image_source, data_dir: Path, synsets: Sequence[str]) -> list
 
 
 class PredictWorker:
-    """RPC surface for shard prediction over a registry of models."""
+    """RPC surface for shard prediction over a registry of models.
 
-    def __init__(self, backends: dict[str, PredictFn]):
+    ``gate`` (cluster/admission.AdmissionGate, optional) bounds concurrent
+    ``job.predict`` work: past max_inflight + max_queue the shard is shed
+    with a typed ``Overloaded`` instead of queuing on the engine lock toward
+    a guaranteed deadline miss. Gang verbs are NOT gated — a collective
+    execution needs every rank, so shedding one would fail the whole gang
+    the leader is about to retry anyway (the scheduler's gang breaker is the
+    backpressure there)."""
+
+    def __init__(self, backends: dict[str, PredictFn], gate=None):
         self.backends = dict(backends)
+        self.gate = gate
 
     def methods(self) -> dict:
         return {
@@ -213,7 +260,11 @@ class PredictWorker:
         fn = self.backends.get(model)
         if fn is None:
             raise RpcError(f"model {model!r} not loaded here; have {sorted(self.backends)}")
-        preds = fn(synsets)
+        if self.gate is not None:
+            with self.gate.admit():
+                preds = fn(synsets)
+        else:
+            preds = fn(synsets)
         if len(preds) != len(synsets):
             raise RpcError(f"backend returned {len(preds)} predictions for {len(synsets)} queries")
         return {"predictions": [int(x) for x in preds]}

@@ -79,6 +79,44 @@ class ClusterConfig:
     # fresh work runs out (tail hedging; dedup makes it exactly-once).
     hedge_tail: bool = True
 
+    # --- overload control (docs/OVERLOAD.md) ---
+    # Per-class deadline defaults, propagated in every RPC frame and
+    # inherited by nested calls (cluster/deadline.py). rpc: small control
+    # verbs (directory lookups, status, job.start); predict: one shard's
+    # batched forward (also the scheduler's shard timeout); transfer: a
+    # whole-blob SDFS replicate/pull (many chunk RPCs under one budget).
+    rpc_deadline_s: float = 60.0
+    predict_deadline_s: float = 120.0
+    transfer_deadline_s: float = 300.0
+    # Admission control: per-member bounded work queues. Up to max_inflight
+    # requests execute while max_queue more wait; past that the request is
+    # shed IMMEDIATELY with a typed Overloaded reply + retry-after hint
+    # instead of queuing toward a guaranteed timeout. 0 disables a gate.
+    predict_max_inflight: int = 32
+    predict_max_queue: int = 128
+    transfer_max_inflight: int = 16
+    transfer_max_queue: int = 64
+    shed_retry_after_s: float = 0.25
+    # Retry budgets + circuit breakers (cluster/retrypolicy.py), shared by
+    # scheduler dispatch, SDFS pulls, failover probes, and the announce
+    # loop: retries to one destination spend a token bucket (rate/burst),
+    # and breaker_threshold consecutive unreachable/deadline/overloaded
+    # failures open a per-peer breaker that admits one half-open probe per
+    # cooldown window.
+    retry_rate_per_s: float = 1.0
+    retry_burst: float = 5.0
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 5.0
+    # Gray-failure ejection (scheduler/jobs.py): a member whose EWMA shard
+    # latency exceeds gray_factor x the fleet median (and the absolute
+    # floor, so microsecond-scale jitter on a fast fleet never ejects
+    # anyone), or whose breaker keeps reopening, is demoted to a quarantine
+    # tier — no new shards, one canary shard per probe interval — and
+    # restored automatically when its latency recovers. 0 disables.
+    gray_factor: float = 3.0
+    gray_min_latency_s: float = 0.25
+    gray_probe_interval_s: float = 5.0
+
     # --- dynamic request micro-batching (scheduler/worker.DynamicBatcher) ---
     # Coalesce concurrent small `job.predict` requests into device-shaped
     # batches: a request waits at most this long for peers before its batch

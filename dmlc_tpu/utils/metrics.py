@@ -17,6 +17,41 @@ from __future__ import annotations
 
 import math
 import random
+import threading
+
+
+class Counters:
+    """Thread-safe named counters + high-water gauges for overload
+    observability (docs/OVERLOAD.md): shed, deadline_exceeded,
+    breaker_open, gray_demotions, queue-depth high-waters, ... One instance
+    per node, shared by the admission gates, the retry policy, and the
+    scheduler, surfaced through ``leader.status`` and the CLI ``status``
+    verb. O(1) per update; the snapshot is a plain dict for the wire."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+        self._high: dict[str, float] = {}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def observe_high(self, name: str, value: float) -> None:
+        """Record a high-water mark: keeps the max ever observed."""
+        with self._lock:
+            if value > self._high.get(name, float("-inf")):
+                self._high[name] = value
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out: dict = dict(self._counts)
+            out.update({f"{k}_high": v for k, v in self._high.items()})
+            return out
 
 
 class LatencyStats:

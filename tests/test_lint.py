@@ -249,7 +249,7 @@ def test_l1_fires_on_rpc_and_sleep_under_lock():
         def f(self):
             with self._lock:
                 time.sleep(1.0)
-                return self.rpc.call("a", "m", {})
+                return self.rpc.call("a", "m", {}, timeout=1.0)
     """
     assert fired(src, "dmlc_tpu/scheduler/x.py") == ["L1", "L1"]
 
@@ -290,7 +290,7 @@ def test_l1_silent_outside_lock_on_cv_wait_and_outside_scope():
             with self._lock:
                 self._cv.wait()  # releases the lock by contract
                 self.counter = 1
-            return self.rpc.call("a", "m", {})  # after release
+            return self.rpc.call("a", "m", {}, timeout=1.0)  # after release
     """
     assert fired(src, "dmlc_tpu/cluster/x.py") == []
     bad = """
@@ -312,7 +312,7 @@ def test_l1_does_not_descend_into_closures():
         def f(self):
             with self._lock:
                 def later():
-                    return self.rpc.call("a", "m", {})  # runs after release
+                    return self.rpc.call("a", "m", {}, timeout=1.0)  # runs after release
                 self.pending = later
     """
     assert fired(src, "dmlc_tpu/cluster/x.py") == []
@@ -541,6 +541,57 @@ def test_f1_suppression_with_justification():
         with open(scratch, "wb") as f:  # dmlc-lint: disable=F1 -- scratch file, committed later by fsync+rename
             for c in chunks:
                 f.write(c)
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# R1 — rpc.call without an explicit timeout/deadline bound
+# ---------------------------------------------------------------------------
+
+
+def test_r1_fires_on_unbounded_rpc_call():
+    src = """
+    def f(self):
+        return self.rpc.call("a", "m", {})
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == ["R1"]
+    assert fired(src, "dmlc_tpu/scheduler/x.py") == ["R1"]
+
+
+def test_r1_silent_with_timeout_or_deadline():
+    src = """
+    def f(self, dl):
+        self.rpc.call("a", "m", {}, timeout=2.0)
+        self.rpc.call("a", "m", {}, deadline=dl)
+        self.rpc.call("a", "m", {}, 5.0)  # positional timeout
+        rpc.call("a", "m", {}, timeout=self.timeout_s)
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == []
+
+
+def test_r1_only_matches_rpc_receivers_and_scope():
+    src = """
+    def f(self):
+        self.network.call("a", "m", {})   # not an rpc handle
+        self.exported.call(vars, batch)   # executable .call, unrelated
+        call("a")                         # bare function
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == []
+    unbounded = """
+    def f(self):
+        return self.rpc.call("a", "m", {})
+    """
+    # Out of scope: parallel/, ops/, tests/ keep their own conventions.
+    assert fired(unbounded, "dmlc_tpu/parallel/x.py") == []
+    assert fired(unbounded, "tests/x.py") == []
+
+
+def test_r1_suppression_with_justification():
+    src = """
+    def f(self):
+        # dmlc-lint: disable=R1 -- interactive operator verb: waiting forever is the UX
+        return self.rpc.call("a", "m", {})
     """
     assert fired(src, "dmlc_tpu/cluster/x.py") == []
 

@@ -128,6 +128,45 @@ def test_authenticated_cluster_end_to_end(tmp_path):
         stop_local_cluster(nodes)
 
 
+def test_status_verb_shows_shed_requests(cluster3):
+    """The CLI `status` verb surfaces the overload counters — and a request
+    shed at a member's admission gate is visible there (docs/OVERLOAD.md)."""
+    from dmlc_tpu.cluster.rpc import Overloaded
+
+    nodes = cluster3
+    member = nodes[2]
+    cli = Cli(member)
+
+    # Baseline: the verb renders the gates and no sheds yet.
+    out = cli.run_command("status")
+    assert "predict gate" in out and "transfer gate" in out
+    assert f"node {member.self_member_addr}" in out
+
+    # Saturate the member's predict gate, then drive one RPC through the
+    # REAL member server: it must shed typed, fast — and be counted.
+    holders = [member.predict_gate.admit() for _ in range(member.predict_gate.capacity)]
+    for h in holders:
+        h.__enter__()
+    try:
+        with pytest.raises(Overloaded):
+            nodes[0].rpc.call(
+                member.self_member_addr,
+                "job.predict",
+                {"model": "resnet18", "synsets": ["n00000001"]},
+                timeout=5.0,
+            )
+    finally:
+        for h in holders:
+            h.__exit__(None, None, None)
+
+    out = cli.run_command("status")
+    assert "shed=1" in out, out
+    assert "shed_predict=1" in out, out
+    # The member's own counter registry saw it too (same numbers the
+    # leader-side status aggregates read).
+    assert member.metrics.get("shed") == 1
+
+
 def test_leader_failover_resumes_jobs(cluster3, tmp_path):
     nodes = cluster3
     leader, standby, member = nodes

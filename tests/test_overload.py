@@ -1,0 +1,624 @@
+"""Overload-control suite (docs/OVERLOAD.md): deadline propagation, admission
+shedding, retry budgets + circuit breakers, and gray-failure ejection.
+
+Two kinds of test live here:
+
+- deterministic sim-fabric tests: SimRpcNetwork's virtual clock + scriptable
+  per-link latency make timeout/breaker/gray behavior replay exactly (the
+  fabric satellite this PR added), so the state machines are pinned without
+  a single real sleep;
+- a seeded real-thread soak: 10x more concurrent requests than the worker
+  admits, one slow "gray" service — the acceptance bar is that every
+  rejected request fast-fails typed (< 1 s, never the old 60 s hang), no
+  admitted request overruns its propagated deadline by more than the grace
+  interval, and the member sheds instead of queueing.
+
+CI runs this file inside the chaos seed matrix (tools/ci_check.sh): the
+DMLC_CHAOS_SEED base offsets every parametrized seed range.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from dmlc_tpu.cluster import deadline as deadline_lib
+from dmlc_tpu.cluster.admission import AdmissionGate
+from dmlc_tpu.cluster.retrypolicy import RetryPolicy
+from dmlc_tpu.cluster.rpc import (
+    DeadlineExceeded,
+    Overloaded,
+    RpcError,
+    RpcUnreachable,
+    SimRpcNetwork,
+    TcpRpc,
+    TcpRpcServer,
+    serve_with_deadline,
+)
+from dmlc_tpu.scheduler.jobs import JobScheduler
+from dmlc_tpu.scheduler.worker import DynamicBatcher, PredictWorker
+from dmlc_tpu.utils.metrics import Counters
+
+SEED_BASE = int(os.environ.get("DMLC_CHAOS_SEED", "0"))
+
+
+def seeds(n: int) -> range:
+    return range(SEED_BASE, SEED_BASE + n)
+
+
+# ---------------------------------------------------------------------------
+# Deadline propagation on the deterministic fabric
+# ---------------------------------------------------------------------------
+
+
+class TestSimDeadlines:
+    def test_timeout_honored_against_link_latency(self):
+        net = SimRpcNetwork()
+        net.serve("b", {"echo": lambda p: {"ok": True}})
+        net.set_latency("a", "b", 5.0)
+        c = net.client("a")
+        t0 = net.now
+        with pytest.raises(RpcUnreachable, match="no reply within"):
+            c.call("b", "echo", {}, timeout=1.0)
+        # The caller really waited out its budget — and ONLY its budget.
+        assert net.now - t0 == pytest.approx(1.0)
+        # Under the latency, calls succeed and the clock advances by transit.
+        net.set_latency("a", "b", 0.25)
+        t0 = net.now
+        assert c.call("b", "echo", {}, timeout=1.0) == {"ok": True}
+        assert net.now - t0 == pytest.approx(0.25)
+
+    def test_server_sheds_work_that_arrives_expired(self):
+        net = SimRpcNetwork()
+        ran = []
+        net.serve("b", {"m": lambda p: ran.append(1) or {}})
+        c = net.client("a")
+        with pytest.raises(DeadlineExceeded):
+            c.call("b", "m", {}, timeout=1.0, deadline=0.0)
+        assert not ran  # never executed: no wasted work for a dead caller
+
+    def test_deadline_checked_after_execution(self):
+        """A method that burns past its budget raises DeadlineExceeded to
+        the caller instead of returning a result the caller gave up on."""
+        net = SimRpcNetwork()
+
+        def slow(p):
+            net.advance(3.0)  # service time, in virtual seconds
+            return {"ok": True}
+
+        net.serve("b", {"slow": slow})
+        c = net.client("a")
+        with pytest.raises(DeadlineExceeded, match="past its"):
+            c.call("b", "slow", {}, timeout=1.0)
+        # With budget to spare the same method answers fine.
+        assert c.call("b", "slow", {}, timeout=10.0) == {"ok": True}
+
+    def test_nested_calls_inherit_remaining_budget(self):
+        """leader -> member -> SDFS-pull shape: the inner hop's budget is
+        the OUTER caller's remainder, not a fresh 60 s default."""
+        net = SimRpcNetwork()
+        seen: list[float] = []
+
+        def inner(p):
+            dl = deadline_lib.current()
+            seen.append(dl.remaining())
+            return {}
+
+        def outer(p):
+            net.advance(0.4)  # the member works a while first
+            # Note: inner timeout says 60, but the ambient deadline caps it.
+            return net.client("b").call("c", "inner", {}, timeout=60.0)
+
+        net.serve("b", {"outer": outer})
+        net.serve("c", {"inner": inner})
+        net.client("a").call("b", "outer", {}, timeout=1.0)
+        assert len(seen) == 1
+        assert seen[0] <= 0.6 + 1e-9  # inherited: 1.0 budget - 0.4 spent
+
+    def test_nested_call_fast_fails_when_budget_is_gone(self):
+        net = SimRpcNetwork()
+        inner_ran = []
+
+        def outer(p):
+            net.advance(2.0)  # overruns the caller's 1.0 budget
+            return net.client("b").call("c", "inner", {}, timeout=60.0)
+
+        net.serve("b", {"outer": outer})
+        net.serve("c", {"inner": lambda p: inner_ran.append(1) or {}})
+        with pytest.raises(DeadlineExceeded):
+            net.client("a").call("b", "outer", {}, timeout=1.0)
+        assert not inner_ran  # the dead branch was pruned at the first hop
+
+
+# ---------------------------------------------------------------------------
+# TCP fabric: single-spend timeout + wire-typed errors (satellite #1)
+# ---------------------------------------------------------------------------
+
+
+class TestTcpDeadlines:
+    def test_timeout_spent_once_across_phases(self):
+        """The old fabric gave connect and recv a FULL timeout each (~2x
+        the stated bound); now one monotonic budget covers all phases."""
+        import socket as socketlib
+
+        # A listener that accepts and then never replies: the call must
+        # fail in ~timeout, not ~2x timeout.
+        srv = socketlib.socket(socketlib.AF_INET, socketlib.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        addr = f"127.0.0.1:{srv.getsockname()[1]}"
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(RpcUnreachable):
+                TcpRpc().call(addr, "m", {}, timeout=0.6)
+            elapsed = time.monotonic() - t0
+            assert elapsed < 1.1, f"timeout double-spent: {elapsed:.2f}s for a 0.6s budget"
+        finally:
+            srv.close()
+
+    def test_deadline_exceeded_and_overloaded_survive_the_wire(self):
+        """Typed verdicts cross the TCP fabric intact: a DeadlineExceeded a
+        method reached (e.g. on a nested hop) arrives typed, and an
+        Overloaded shed arrives typed WITH its retry-after hint."""
+        gate = AdmissionGate(1, 0, name="predict", retry_after_s=0.125)
+
+        def nested_verdict(p):
+            raise DeadlineExceeded("nested hop ran out of budget")
+
+        def gated(p):
+            with gate.admit():
+                time.sleep(float(p.get("sleep", 0)))
+                return {"ok": True}
+
+        server = TcpRpcServer(
+            "127.0.0.1", 0, {"nested": nested_verdict, "gated": gated}
+        )
+        try:
+            rpc = TcpRpc()
+            with pytest.raises(DeadlineExceeded):
+                rpc.call(server.address, "nested", {}, timeout=2.0)
+            # Overloaded verdict: saturate the single admission slot, then
+            # call again.
+            holder = threading.Thread(
+                target=lambda: rpc.call(
+                    server.address, "gated", {"sleep": 0.8}, timeout=5.0
+                ),
+            )
+            holder.start()
+            time.sleep(0.15)  # let the holder occupy the slot
+            try:
+                with pytest.raises(Overloaded) as exc:
+                    rpc.call(server.address, "gated", {}, timeout=5.0)
+                assert exc.value.retry_after_s == pytest.approx(0.125)
+            finally:
+                holder.join(timeout=5)
+        finally:
+            server.close()
+
+    def test_client_side_timeout_is_overload_class(self):
+        """When the server overruns and the CLIENT's clock trips first, the
+        verdict is RpcUnreachable — still overload-class for the breaker."""
+        server = TcpRpcServer(
+            "127.0.0.1", 0, {"slow": lambda p: time.sleep(0.5) or {}}
+        )
+        try:
+            from dmlc_tpu.cluster.retrypolicy import is_overload_error
+
+            with pytest.raises(RpcUnreachable) as exc:
+                TcpRpc().call(server.address, "slow", {}, timeout=0.1)
+            assert is_overload_error(exc.value)
+        finally:
+            server.close()
+
+
+# ---------------------------------------------------------------------------
+# Admission control: gates shed, batcher brownouts (tentpole part 2)
+# ---------------------------------------------------------------------------
+
+
+class TestAdmission:
+    def test_gate_sheds_past_capacity_and_counts(self):
+        metrics = Counters()
+        gate = AdmissionGate(2, 1, name="predict", metrics=metrics)
+        holders = [gate.admit() for _ in range(3)]
+        for h in holders:
+            h.__enter__()
+        with pytest.raises(Overloaded, match="queue full"):
+            with gate.admit():
+                pass
+        for h in holders:
+            h.__exit__(None, None, None)
+        # Released capacity admits again.
+        with gate.admit():
+            pass
+        s = gate.summary()
+        assert s["sheds"] == 1 and s["admitted"] == 4
+        assert s["queue_hw"] == 1  # one request sat beyond max_inflight
+        snap = metrics.snapshot()
+        assert snap["shed"] == 1 and snap["shed_predict"] == 1
+        assert snap["queue_hw_predict_high"] == 1
+
+    def test_disabled_gate_admits_everything(self):
+        gate = AdmissionGate(0, 0)
+        ctxs = [gate.admit() for _ in range(100)]
+        for c in ctxs:
+            c.__enter__()
+        for c in ctxs:
+            c.__exit__(None, None, None)
+        assert gate.summary()["sheds"] == 0
+
+    def test_predict_worker_sheds_through_gate(self):
+        gate = AdmissionGate(1, 0, name="predict")
+        worker = PredictWorker({"m": lambda synsets: [0] * len(synsets)}, gate=gate)
+        # Occupy the only slot, then the RPC surface must shed typed.
+        hold = gate.admit()
+        hold.__enter__()
+        try:
+            with pytest.raises(Overloaded):
+                worker._predict({"model": "m", "synsets": ["x"]})
+        finally:
+            hold.__exit__(None, None, None)
+        assert worker._predict({"model": "m", "synsets": ["x"]})["predictions"] == [0]
+
+    def test_batcher_bounded_queue_sheds_typed(self):
+        release = threading.Event()
+
+        def blocked(synsets):
+            release.wait(5.0)
+            return [int(s) for s in synsets]
+
+        metrics = Counters()
+        batcher = DynamicBatcher(
+            blocked, batch_size=2, max_wait_s=0.01, max_queue=4, metrics=metrics
+        )
+        try:
+            futs = [batcher.submit(str(i)) for i in range(2)]  # in the backend
+            time.sleep(0.1)  # worker picks them up, blocks in `blocked`
+            futs += [batcher.submit(str(i)) for i in range(2, 6)]  # fills queue
+            with pytest.raises(Overloaded) as exc:
+                batcher.submit("nope")
+            assert exc.value.retry_after_s == pytest.approx(0.01)
+            release.set()
+            assert sorted(f.result(timeout=5) for f in futs) == list(range(6))
+            s = batcher.summary()
+            assert s["sheds"] == 1 and s["queue_hw"] == 4
+            assert metrics.snapshot()["shed_microbatch"] == 1
+        finally:
+            release.set()
+            batcher.stop()
+
+    def test_batcher_brownout_skips_wait_when_queue_deep(self):
+        """With the queue at its bound, the coalescing wait must collapse
+        toward zero — the batcher dispatches as fast as the device drains
+        instead of adding latency it no longer has."""
+        calls: list[float] = []
+
+        def backend(synsets):
+            calls.append(time.monotonic())
+            return [int(s) for s in synsets]
+
+        # max_wait_s is LONG (0.5 s); queue bound floors at 2*batch = 8.
+        batcher = DynamicBatcher(backend, batch_size=4, max_wait_s=0.5, max_queue=4)
+        try:
+            t0 = time.monotonic()
+            futs = [batcher.submit(str(i)) for i in range(8)]
+            for f in futs:
+                f.result(timeout=5)
+            elapsed = time.monotonic() - t0
+            # Un-brownouted, two partial waits would cost ~1.0 s; full
+            # batches + pressure-shrunk waits finish far faster.
+            assert elapsed < 0.45, f"brownout failed to shrink the wait: {elapsed:.2f}s"
+        finally:
+            batcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# Retry budgets + circuit breakers (tentpole part 3)
+# ---------------------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_breaker_opens_half_opens_and_recovers(self):
+        net = SimRpcNetwork()
+        policy = RetryPolicy(clock=net.clock, breaker_threshold=3, breaker_cooldown_s=5.0)
+        net.serve("b", {"m": lambda p: {}})
+        net.crash("b")
+        c = net.client("a")
+        for _ in range(3):
+            assert policy.allow("b")
+            with pytest.raises(RpcUnreachable) as exc:
+                c.call("b", "m", {}, timeout=1.0)
+            policy.record("b", exc.value)
+        assert policy.breaker_state("b") == "open"
+        # While open, nothing is allowed — and no RPC leaves the node.
+        before = len(net.calls)
+        assert not policy.allow("b")
+        assert len(net.calls) == before
+        # Cooldown elapses -> half-open admits exactly ONE probe.
+        net.advance(5.0)
+        assert policy.allow("b")
+        assert not policy.allow("b"), "half-open must admit a single probe"
+        # The probe fails (member still down): snaps back open.
+        with pytest.raises(RpcUnreachable) as exc:
+            c.call("b", "m", {}, timeout=1.0)
+        policy.record("b", exc.value)
+        assert policy.breaker_state("b") == "open"
+        # Member restarts; next window's probe succeeds -> closed.
+        net.restart("b")
+        net.advance(5.0)
+        assert policy.allow("b")
+        c.call("b", "m", {}, timeout=1.0)
+        policy.record("b")
+        assert policy.breaker_state("b") == "closed"
+        assert policy.open_count("b") == 2
+
+    def test_method_errors_do_not_trip_the_breaker(self):
+        policy = RetryPolicy(clock=lambda: 0.0, breaker_threshold=2)
+        for _ in range(10):
+            policy.record("b", RpcError("semantic refusal"))
+        assert policy.breaker_state("b") == "closed"
+
+    def test_retry_budget_token_bucket(self):
+        now = [0.0]
+        policy = RetryPolicy(clock=lambda: now[0], retry_rate_per_s=1.0, retry_burst=3.0)
+        assert [policy.allow_retry("b") for _ in range(5)] == [
+            True, True, True, False, False,
+        ]
+        now[0] += 2.0  # refill 2 tokens
+        assert policy.allow_retry("b") and policy.allow_retry("b")
+        assert not policy.allow_retry("b")
+        # Budgets are per destination: "c" is untouched.
+        assert policy.allow_retry("c")
+
+    def test_denials_are_counted(self):
+        metrics = Counters()
+        policy = RetryPolicy(
+            clock=lambda: 0.0, breaker_threshold=1, retry_burst=1.0, metrics=metrics
+        )
+        policy.record("b", RpcUnreachable("down"))  # opens at threshold 1
+        assert not policy.allow("b")
+        assert policy.allow_retry("c") and not policy.allow_retry("c")
+        snap = metrics.snapshot()
+        assert snap["breaker_open"] == 1
+        assert snap["breaker_denied"] >= 1 and snap["retries_denied"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Scheduler integration: budgeted retries + gray ejection (tentpole parts 3+4)
+# ---------------------------------------------------------------------------
+
+
+def make_workload(n):
+    return [(f"n{i:05d}", i) for i in range(n)]
+
+
+class GrayFixture:
+    """N echo members on the sim fabric; per-link latency models health.
+    The scheduler's timer IS the fabric's virtual clock, so member EWMAs
+    observe exactly the scripted latencies."""
+
+    def __init__(
+        self,
+        n_members=6,
+        n_queries=96,
+        shard=8,
+        predict_deadline=1.0,
+        gray_factor=3.0,
+        gray_probe_interval=0.5,
+        policy_kw=None,
+    ):
+        self.net = SimRpcNetwork()
+        self.members = [f"m{i}" for i in range(n_members)]
+        self.served: dict[str, int] = {m: 0 for m in self.members}
+        for m in self.members:
+            def backend(synsets, member=m):
+                self.served[member] += len(synsets)
+                return [int(s[1:]) for s in synsets]
+
+            self.net.serve(m, PredictWorker({"resnet18": backend}).methods())
+            self.net.set_latency("L", m, 0.01)
+        self.metrics = Counters()
+        self.policy = RetryPolicy(
+            clock=self.net.clock, metrics=self.metrics, **(policy_kw or {})
+        )
+        self.scheduler = JobScheduler(
+            self.net.client("L"),
+            lambda: list(self.members),
+            jobs={"resnet18": make_workload(n_queries)},
+            shard_size=shard,
+            shard_timeout_s=predict_deadline,
+            timer=self.net.clock,
+            hedge_tail=False,  # isolate retry/gray behavior from hedging
+            retry_policy=self.policy,
+            gray_factor=gray_factor,
+            gray_min_latency_s=0.05,
+            gray_probe_interval_s=gray_probe_interval,
+            metrics=self.metrics,
+        )
+        self.scheduler.is_leading = True
+
+    def calls_to(self, member: str) -> int:
+        return sum(1 for addr, m in self.net.calls if addr == member and m == "job.predict")
+
+
+class TestGrayEjection:
+    def test_slow_member_demoted_then_restored(self):
+        # The workload must OUTLIVE the recovery: canaries are real shards,
+        # so restoration (several probe intervals of good samples) needs
+        # work still flowing when the member heals.
+        f = GrayFixture(n_queries=4000, gray_probe_interval=0.2)
+        slow = "m3"
+        f.net.set_latency("L", slow, 0.5)  # slow but ALIVE (under the deadline)
+        f.scheduler._start({})
+
+        demoted_seen = False
+        for _ in range(2000):
+            f.scheduler.assign_once()
+            f.scheduler.dispatch_all_once()
+            if slow in f.scheduler.demoted:
+                demoted_seen = True
+                break
+        assert demoted_seen, "slow-but-alive member never demoted"
+        assert f.metrics.get("gray_demotions") == 1
+        # Quarantined: no NEW assignment...
+        f.scheduler.assign_once()
+        for job in f.scheduler.jobs.values():
+            if job.running:
+                assert slow not in job.assigned
+        # ...but canary probes keep flowing, and recovery restores it.
+        f.net.set_latency("L", slow, 0.01)
+        before = f.calls_to(slow)
+        for _ in range(4000):
+            f.scheduler.assign_once()
+            if f.scheduler.dispatch_all_once() == 0:
+                f.net.advance(0.05)  # idle tick: virtual time still passes
+            if slow not in f.scheduler.demoted:
+                break
+            if all(j.done for j in f.scheduler.jobs.values()):
+                break
+        assert slow not in f.scheduler.demoted, "recovered member never restored"
+        assert f.calls_to(slow) > before, "no canary probes reached the demoted member"
+        assert f.metrics.get("gray_restored") == 1
+
+    def test_breaker_reopening_demotes_member(self):
+        f = GrayFixture(n_queries=400, policy_kw={"breaker_threshold": 2,
+                                                  "breaker_cooldown_s": 0.2})
+        flaky = "m1"
+        f.net.crash(flaky)  # unreachable: breaker food, not latency food
+        f.scheduler._start({})
+        for _ in range(3000):
+            f.scheduler.assign_once()
+            if f.scheduler.dispatch_all_once() == 0:
+                f.net.advance(0.1)
+            if flaky in f.scheduler.demoted:
+                break
+            if all(j.done for j in f.scheduler.jobs.values()):
+                break
+        assert flaky in f.scheduler.demoted, "reopening breaker never demoted the member"
+
+
+@pytest.mark.parametrize("seed", seeds(3))
+def test_overload_soak_gray_member_bounded_retries(seed):
+    """The sim-side acceptance soak: a full workload against a fleet with
+    one gray (slow-but-alive) member. Asserts, per the issue:
+
+    - the gray member is demoted and — after its latency recovers — restored;
+    - total dispatches to it stay within the retry budget's order of
+      magnitude (no storm: bounded by first-attempts + tokens + canaries);
+    - every admitted shard's recorded latency stays under the propagated
+      deadline + grace;
+    - the workload completes exactly once despite the turbulence.
+    """
+    rng = random.Random(seed)
+    n_queries = 2400
+    f = GrayFixture(
+        n_queries=n_queries,
+        predict_deadline=1.0,
+        gray_probe_interval=0.2,
+        policy_kw={"retry_rate_per_s": 2.0, "retry_burst": 4.0},
+    )
+    slow = rng.choice(f.members)
+    f.net.set_latency("L", slow, 0.6)
+    f.scheduler._start({})
+
+    was_demoted = False
+    healed = False
+    for step in range(20_000):
+        if all(j.done for j in f.scheduler.jobs.values()):
+            break
+        if step % 5 == 0:
+            f.scheduler.assign_once()
+        if f.scheduler.dispatch_all_once() == 0:
+            f.net.advance(0.05)
+        if not was_demoted and slow in f.scheduler.demoted:
+            was_demoted = True
+        if was_demoted and not healed and rng.random() < 0.2:
+            f.net.set_latency("L", slow, 0.01)  # the thermal event passes
+            healed = True
+    job = f.scheduler.jobs["resnet18"]
+    assert job.finished == n_queries and job.correct == n_queries, (
+        f"lost/duplicated work (seed {seed})"
+    )
+    assert was_demoted, f"gray member {slow} never demoted (seed {seed})"
+    assert slow not in f.scheduler.demoted, f"{slow} never restored (seed {seed})"
+    # No storm: while gray, the member saw only its pre-demotion shards,
+    # budgeted retries, and interval-spaced canaries — far below the shard
+    # count a naive requeue loop would have thrown at it.
+    assert f.calls_to(slow) < n_queries // 2, (
+        f"{f.calls_to(slow)} dispatches to the gray member looks like a "
+        f"retry storm (seed {seed})"
+    )
+    # Admitted work never overran deadline + grace (0.25 s).
+    worst = max(f.scheduler.jobs["resnet18"].shard_stats.reservoir)
+    assert worst <= 1.0 + 0.25, f"admitted shard took {worst:.2f}s (seed {seed})"
+
+
+# ---------------------------------------------------------------------------
+# Real-thread acceptance soak: 10x burst, typed fast-fails, bounded p99
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", seeds(1))
+def test_overload_soak_threads_fast_fail_and_bounded_p99(seed):
+    """10x the worker's admission capacity arrives at once (real threads,
+    real clock). Every request must resolve FAST and TYPED: rejected ones
+    in well under a second via Overloaded/DeadlineExceeded, admitted ones
+    inside deadline + grace. Nothing hangs toward the old 60 s default."""
+    rng = random.Random(seed)
+    service_s = 0.02
+    gate = AdmissionGate(2, 2, name="predict", metrics=Counters(), retry_after_s=0.05)
+
+    def backend(synsets):
+        time.sleep(service_s)
+        return [int(s) for s in synsets]
+
+    worker = PredictWorker({"m": backend}, gate=gate)
+    methods = worker.methods()
+    deadline_s = 1.0
+    n = 40  # 10x the gate's capacity of 4
+    results: dict[int, tuple[str, float]] = {}
+
+    def one(i: int, jitter: float) -> None:
+        time.sleep(jitter)
+        t0 = time.monotonic()
+        try:
+            serve_with_deadline(
+                methods, "job.predict",
+                {"model": "m", "synsets": [str(i)]},
+                deadline_s, time.monotonic,
+            )
+            verdict = "ok"
+        except Overloaded:
+            verdict = "shed"
+        except DeadlineExceeded:
+            verdict = "deadline"
+        results[i] = (verdict, time.monotonic() - t0)
+
+    threads = [
+        threading.Thread(target=one, args=(i, rng.uniform(0, 0.01))) for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(results) == n, "some requests never resolved"
+
+    verdicts = [v for v, _ in results.values()]
+    shed = verdicts.count("shed")
+    ok = verdicts.count("ok")
+    assert shed > 0, "a 10x burst against a capacity-4 gate must shed"
+    assert ok > 0, "admitted work must still complete under overload"
+    # Typed rejections are FAST: well under the 1 s bar (and nowhere near
+    # the old 60 s hang).
+    for i, (verdict, elapsed) in results.items():
+        if verdict in ("shed", "deadline"):
+            assert elapsed < 1.0, f"request {i} {verdict} after {elapsed:.2f}s"
+        else:
+            assert elapsed <= deadline_s + 0.25, (
+                f"admitted request {i} overran deadline+grace: {elapsed:.2f}s"
+            )
+    assert gate.summary()["sheds"] == shed

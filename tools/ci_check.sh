@@ -14,10 +14,12 @@
 #                         concurrent submitters and pool shutdown/regrow
 #                         (tests/test_native_sanitize.py)
 #   7. chaos matrix     — the seeded fault-injection suites (crashes,
-#                         partitions, failover, disk bit-rot/torn writes)
-#                         across a 3-seed-base matrix: each leg offsets
-#                         every parametrized seed range into a disjoint
-#                         region of the fault space (DMLC_CHAOS_SEED)
+#                         partitions, failover, disk bit-rot/torn writes,
+#                         overload: deadlines/shedding/breakers/gray
+#                         ejection) across a 3-seed-base matrix: each leg
+#                         offsets every parametrized seed range into a
+#                         disjoint region of the fault space
+#                         (DMLC_CHAOS_SEED)
 #
 # Tools the image does not ship (ruff, mypy, clang-tidy) are SKIPPED with
 # a notice instead of failing the gate — the repo must not depend on
@@ -80,14 +82,15 @@ else
   fail=1
 fi
 
-note "chaos suite (3-seed matrix: crashes/partitions/failover x disk faults)"
+note "chaos suite (3-seed matrix: crashes/partitions/failover x disk faults x overload)"
 for seed_base in 0 1000 2000; do
   note "chaos matrix leg DMLC_CHAOS_SEED=$seed_base"
   if env JAX_PLATFORMS=cpu DMLC_CHAOS_SEED="$seed_base" python -m pytest \
-      tests/test_chaos.py tests/test_sdfs_faults.py -q -p no:cacheprovider; then
+      tests/test_chaos.py tests/test_sdfs_faults.py tests/test_overload.py \
+      -q -p no:cacheprovider; then
     note "chaos leg $seed_base OK"
   else
-    note "chaos leg $seed_base FAILED (replay: DMLC_CHAOS_SEED=$seed_base pytest tests/test_chaos.py tests/test_sdfs_faults.py)"
+    note "chaos leg $seed_base FAILED (replay: DMLC_CHAOS_SEED=$seed_base pytest tests/test_chaos.py tests/test_sdfs_faults.py tests/test_overload.py)"
     fail=1
   fi
 done

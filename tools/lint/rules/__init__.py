@@ -61,6 +61,7 @@ from tools.lint.rules import (  # noqa: E402
     jit,
     locks,
     persistence,
+    rpctimeout,
     wallclock,
 )
 
@@ -73,4 +74,5 @@ RULES = [
     excepts.E1,
     hotpath.H1,
     persistence.F1,
+    rpctimeout.R1,
 ]

@@ -1,0 +1,69 @@
+"""R1 — every ``rpc.call(...)`` in cluster/ and scheduler/ must bound its wait.
+
+The overload-control contract (docs/OVERLOAD.md) is that NO control-plane
+call waits on a dead or drowning peer for the implicit 60 s default: each
+call states its budget — ``timeout=`` (per-class defaults live on
+ClusterConfig: ``rpc_deadline_s`` / ``predict_deadline_s`` /
+``transfer_deadline_s``) or ``deadline=`` (a propagated budget from
+cluster/deadline.py) — so a hung peer costs a *chosen* bounded wait and the
+retry policy can reason about it. One bare ``rpc.call`` site reintroduces
+the 60 s hang the maintenance loops were de-fanged of.
+
+Flagged inside ``dmlc_tpu/cluster/`` and ``dmlc_tpu/scheduler/``:
+
+- ``<x>.rpc.call(...)`` / ``rpc.call(...)`` (any receiver chain whose last
+  attribute before ``.call`` is named ``rpc``) with neither a ``timeout=``
+  nor a ``deadline=`` keyword (a 4th/5th positional argument counts too).
+
+Legitimate exceptions (a call that genuinely must wait indefinitely) use
+the standard justified suppression: ``# dmlc-lint: disable=R1 -- why``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Finding
+from tools.lint.rules import dotted_name
+
+
+class _R1:
+    id = "R1"
+    summary = "rpc.call without an explicit timeout=/deadline= bound"
+    hint = ("pass timeout= (per-class config defaults: rpc_deadline_s / "
+            "predict_deadline_s / transfer_deadline_s) or deadline= "
+            "(cluster/deadline.py), or justify the unbounded wait with "
+            "'# dmlc-lint: disable=R1 -- why'")
+    scope_doc = "dmlc_tpu/cluster/, dmlc_tpu/scheduler/"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith(("dmlc_tpu/cluster/", "dmlc_tpu/scheduler/"))
+
+    def check(self, tree: ast.AST, relpath: str) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not (isinstance(func, ast.Attribute) and func.attr == "call"):
+                continue
+            receiver = dotted_name(func.value)
+            # `self.rpc.call`, `rpc.call`, `node.rpc.call`, ... — the
+            # receiver chain must END in a name called `rpc`. (A file-local
+            # rule cannot type-infer; the project convention is that Rpc
+            # handles are always bound as `rpc`.)
+            if receiver is None or receiver.split(".")[-1] != "rpc":
+                continue
+            if any(kw.arg in ("timeout", "deadline") for kw in node.keywords):
+                continue
+            if len(node.args) >= 4:  # positional timeout
+                continue
+            findings.append(Finding(
+                relpath, node.lineno, node.col_offset, self.id,
+                "rpc.call without timeout=/deadline=: this call waits the "
+                "implicit 60 s default on a dead or drowning peer",
+            ))
+        return findings
+
+
+R1 = _R1()
