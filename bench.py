@@ -996,12 +996,35 @@ def bench_e2e(
     # Overlapped end-to-end (decode || transfer || device), with the
     # per-stage attribution the engine's ingest counters record: where the
     # e2e seconds actually go (decode vs h2d staging vs dispatch vs sync).
-    e2e_s = serial_s = stage_seconds = None
+    # The tracer runs over this leg too: its per-span aggregates land in
+    # bench_detail.json ("span_aggregates"), so a future BENCH_*.json delta
+    # can be attributed to a STAGE (decode vs stage vs dispatch vs sync)
+    # instead of just observed at the headline.
+    e2e_s = serial_s = stage_seconds = span_aggregates = None
     if time_left() > 0:
+        from dmlc_tpu.utils.tracing import tracer
+
         engine.reset_ingest_stats()
-        t0 = time.perf_counter()
-        engine.run_paths_stream(paths)
-        e2e_s = time.perf_counter() - t0
+        was_enabled = tracer.enabled
+        tracer.reset()
+        tracer.enabled = True
+        try:
+            t0 = time.perf_counter()
+            engine.run_paths_stream(paths)
+            e2e_s = time.perf_counter() - t0
+        finally:
+            tracer.enabled = was_enabled
+        span_aggregates = {
+            name: {
+                "count": int(s["count"]),
+                "mean_ms": round(s["mean"] * 1e3, 3),
+                "p99_ms": round(s["p99"] * 1e3, 3),
+                "total_s": round(s["mean"] * s["count"], 3),
+            }
+            for name, s in tracer.summary().items()
+            if isinstance(s, dict) and s.get("count")
+        }
+        tracer.reset()
         ing = engine.ingest_summary()
         stage_seconds = {
             k: round(ing[k]["total_s"], 3)
@@ -1046,6 +1069,10 @@ def bench_e2e(
         # host-side XLA dispatch, sync = host stalls on device results. The
         # dominant stage is the pipeline's bottleneck.
         "stage_seconds": stage_seconds,
+        # Tracer span aggregates over the same e2e leg (count/mean/p99 per
+        # span name): the regression-attribution record — when e2e_img_s
+        # moves between BENCH_r*.json rounds, diff these to name the stage.
+        "span_aggregates": span_aggregates,
     }
 
 

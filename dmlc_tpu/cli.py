@@ -70,8 +70,16 @@ Commands (reference: README.md:10-23):
   status                                overload-control counters: sheds,
                                         deadline trips, queue high-water,
                                         breakers, gray-demoted members
-  trace on|off|summary|export <path>    span tracing: toggle, aggregate table,
-                                        Chrome trace JSON (chrome://tracing)
+  metrics [prom|fleet]                  this node's metric registry (counters,
+                                        gauges, latency summaries); `prom` =
+                                        Prometheus text; `fleet` = the leader's
+                                        latest per-member scrape
+  trace on|off|summary|export <path>    span tracing: toggle FLEET-WIDE,
+                                        aggregate table, local Chrome trace
+  trace fleet <path>                    merged fleet trace: every node's spans,
+                                        clock-aligned, one pid lane per node
+  flight [member]                       flight-recorder event ring (breaker /
+                                        gray / quarantine / shed transitions)
   help                                  this text
   exit | quit                           leave and stop the node
 """
@@ -296,30 +304,115 @@ class Cli:
             elif s.get("cluster_error"):
                 out.append(f"  leader unreachable: {s['cluster_error']}")
             return "\n".join(out)
+        if cmd == "metrics":
+            sub = args[0] if args else "show"
+            if sub == "prom":
+                return n.registry.prometheus_text() or "(no metrics yet)"
+            if sub == "fleet":
+                try:
+                    fleet = n.rpc.call(
+                        n.tracker.current, "obs.fleet", {}, timeout=5.0
+                    )["fleet"]
+                except Exception as e:
+                    return f"leader fleet scrape unavailable: {e}"
+                if not fleet:
+                    return "no fleet scrape yet (leader scrapes on the probe cadence)"
+                rows = []
+                for addr, reply in sorted(fleet.items()):
+                    counters = (reply.get("metrics") or {}).get("counters") or {}
+                    nonzero = {k: v for k, v in sorted(counters.items()) if v}
+                    rows.append([
+                        addr,
+                        ", ".join(f"{k}={v}" for k, v in nonzero.items()) or "(all zero)",
+                    ])
+                return format_table(["node", "counters"], rows)
+            if sub == "show":
+                snap = n.registry.snapshot()
+                out = []
+                counters = {k: v for k, v in sorted(snap["counters"].items()) if v}
+                out.append(
+                    "counters: "
+                    + (", ".join(f"{k}={v}" for k, v in counters.items()) or "(all zero)")
+                )
+                gauges = {k: v for k, v in sorted(snap["gauges"].items()) if v is not None}
+                out.append(
+                    "gauges:   "
+                    + (", ".join(f"{k}={v:g}" for k, v in gauges.items()) or "(none)")
+                )
+                for name, s in sorted(snap["latency"].items()):
+                    out.append(f"  {name}: {format_latency(s)}")
+                return "\n".join(out)
+            return "usage: metrics [prom|fleet]"
+        if cmd == "flight":
+            if args:
+                wire = n.rpc.call(args[0], "obs.flight", {}, timeout=5.0)
+            else:
+                wire = n.flight.to_wire()
+            events = wire.get("events", [])
+            head = (
+                f"flight ring: {len(events)} event(s) held, "
+                f"{wire.get('recorded', 0)} recorded, "
+                f"{wire.get('dropped', 0)} aged out"
+            )
+            lines = [head]
+            for e in events[-50:]:
+                fields = ", ".join(
+                    f"{k}={v}" for k, v in sorted(e.items()) if k not in ("t", "kind")
+                )
+                lines.append(f"  t={e.get('t', 0):.3f} {e.get('kind')} {fields}")
+            return "\n".join(lines)
         if cmd == "trace":
+            from dmlc_tpu.cluster import observe
             from dmlc_tpu.utils.tracing import tracer
 
             sub = args[0] if args else "summary"
-            if sub == "on":
-                tracer.enabled = True
-                return "tracing enabled"
-            if sub == "off":
-                tracer.enabled = False
-                return "tracing disabled"
+            if sub in ("on", "off", "start", "stop"):
+                enable = sub in ("on", "start")
+                tracer.enabled = enable
+                # Arm/disarm the whole fleet (best-effort): spans only merge
+                # into one timeline if every node records them.
+                reached = observe.set_fleet_tracing(
+                    n.rpc,
+                    [a for a in n.active_member_addrs() if a != n.self_member_addr],
+                    enable,
+                )
+                ok = sum(1 for v in reached.values() if v)
+                verb = "enabled" if enable else "disabled"
+                return f"tracing {verb} (fleet: {ok}/{len(reached)} peers reached)"
             if sub == "export":
                 if len(args) != 2:
                     return "usage: trace export <path>"
                 tracer.export(args[1])
                 return f"wrote Chrome trace to {args[1]} (open in chrome://tracing)"
+            if sub == "fleet":
+                if len(args) != 2:
+                    return "usage: trace fleet <path>"
+                doc = observe.export_fleet_trace(
+                    n.rpc, sorted(set(n.active_member_addrs()) | {n.self_member_addr}),
+                    args[1],
+                )
+                lanes = {e.get("pid") for e in doc["traceEvents"] if e.get("ph") == "X"}
+                return (
+                    f"wrote merged fleet trace to {args[1]}: "
+                    f"{sum(1 for e in doc['traceEvents'] if e.get('ph') == 'X')} "
+                    f"span(s) across {len(lanes)} node lane(s)"
+                )
             if sub == "summary":
-                # format_latency already leads with n=<count>.
-                rows = [
-                    [name, format_latency(s)] for name, s in tracer.summary().items()
-                ]
+                rows = []
+                dropped = None
+                for name, s in tracer.summary().items():
+                    if name == "dropped_events":
+                        dropped = s
+                        continue
+                    # format_latency already leads with n=<count>.
+                    rows.append([name, format_latency(s)])
                 if not rows:
                     return "no spans recorded (is tracing on?)"
-                return format_table(["span", "latency"], rows)
-            return "usage: trace on|off|summary|export <path>"
+                table = format_table(["span", "latency"], rows)
+                if dropped:
+                    table += f"\nWARNING: {dropped} span(s) dropped past max_events"
+                return table
+            return "usage: trace on|off|summary|export <path>|fleet <path>"
         if cmd == "help":
             return HELP
         if cmd in ("exit", "quit"):

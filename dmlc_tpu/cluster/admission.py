@@ -38,11 +38,15 @@ class AdmissionGate:
         name: str = "work",
         metrics=None,
         retry_after_s: float = 0.25,
+        flight=None,
     ):
         self.max_inflight = int(max_inflight)
         self.max_queue = max(0, int(max_queue))
         self.name = name
         self.metrics = metrics
+        # Flight recorder (cluster/flight.py, optional): sheds are the
+        # request-path transition worth a timestamped postmortem record.
+        self.flight = flight
         self.retry_after_s = float(retry_after_s)
         self._lock = threading.Lock()
         self.active = 0
@@ -68,6 +72,8 @@ class AdmissionGate:
                     self.metrics.inc("shed")
                     self.metrics.inc(f"shed_{self.name}")
                 tracer.record(f"overload/shed_{self.name}", 0.0)
+                if self.flight is not None:
+                    self.flight.note("shed", gate=self.name, active=self.active)
                 raise Overloaded(
                     f"{self.name}: {self.active} in flight / queue full "
                     f"(max_inflight={self.max_inflight}, max_queue={self.max_queue})",

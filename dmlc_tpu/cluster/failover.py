@@ -22,6 +22,7 @@ import logging
 from typing import Callable
 
 from dmlc_tpu.cluster.rpc import Rpc, RpcError, RpcUnreachable
+from dmlc_tpu.utils.tracing import tracer
 
 log = logging.getLogger(__name__)
 
@@ -66,7 +67,10 @@ class LeaderTracker:
             reason = "breaker open (recent probes failed)"
         else:
             try:
-                status = self.rpc.call(self.current, "leader.status", {}, timeout=timeout)
+                with tracer.span("failover/probe", candidate=self.current):
+                    status = self.rpc.call(
+                        self.current, "leader.status", {}, timeout=timeout
+                    )
                 if self.retry_policy is not None:
                     self.retry_policy.record(self.current)
                 if status.get("leading"):

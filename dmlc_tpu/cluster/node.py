@@ -29,10 +29,13 @@ import logging
 import threading
 from pathlib import Path
 
+from dmlc_tpu.cluster import observe
 from dmlc_tpu.cluster.admission import AdmissionGate
 from dmlc_tpu.cluster.clock import Clock
 from dmlc_tpu.cluster.failover import LeaderTracker, StandbyLeader
+from dmlc_tpu.cluster.flight import FlightRecorder
 from dmlc_tpu.cluster.membership import MembershipNode
+from dmlc_tpu.cluster.observe import ObsService
 from dmlc_tpu.cluster.retrypolicy import RetryPolicy
 from dmlc_tpu.cluster.rpc import TcpRpc, TcpRpcServer
 from dmlc_tpu.cluster.sdfs import MemberStore, SdfsClient, SdfsLeader, SdfsMember
@@ -45,8 +48,10 @@ from dmlc_tpu.scheduler.worker import (
     ModelLoader,
     PredictWorker,
 )
+from dmlc_tpu.utils import tracing
 from dmlc_tpu.utils.config import ClusterConfig
-from dmlc_tpu.utils.metrics import Counters
+from dmlc_tpu.utils.metrics import Counters, Registry
+from dmlc_tpu.utils.tracing import traced_methods
 
 log = logging.getLogger(__name__)
 
@@ -97,11 +102,17 @@ class ClusterNode:
         self._threads: list[threading.Thread] = []
         self._announced = False  # restart inventory re-announce (probe loop)
 
-        # --- overload control (docs/OVERLOAD.md) ------------------------
-        # ONE counter registry and ONE retry governor per node, shared by
-        # every component: the CLI `status` verb and leader.status read the
-        # same numbers the gates/breakers write.
+        # --- observability plane (docs/OBSERVABILITY.md) ----------------
+        # ONE counter registry, ONE flight recorder, and ONE retry governor
+        # per node, shared by every component: the CLI `status`/`metrics`
+        # verbs, leader.status, and the obs.* scrape surface all read the
+        # same numbers the gates/breakers/scheduler write.
         self.metrics = Counters()
+        self.lane = f"{config.host}:{config.member_port}"
+        self.flight = FlightRecorder(
+            clock=self.clock.monotonic, node=self.lane
+        )
+        self.registry = Registry(counters=self.metrics)
         self.retry_policy = RetryPolicy(
             clock=self.clock.monotonic,
             breaker_threshold=config.breaker_threshold,
@@ -109,6 +120,7 @@ class ClusterNode:
             retry_rate_per_s=config.retry_rate_per_s,
             retry_burst=config.retry_burst,
             metrics=self.metrics,
+            flight=self.flight,
         )
         self.predict_gate = AdmissionGate(
             config.predict_max_inflight,
@@ -116,6 +128,7 @@ class ClusterNode:
             name="predict",
             metrics=self.metrics,
             retry_after_s=config.shed_retry_after_s,
+            flight=self.flight,
         )
         self.transfer_gate = AdmissionGate(
             config.transfer_max_inflight,
@@ -123,14 +136,24 @@ class ClusterNode:
             name="transfer",
             metrics=self.metrics,
             retry_after_s=config.shed_retry_after_s,
+            flight=self.flight,
         )
+        self.registry.gauge("predict_gate_active", lambda: self.predict_gate.active)
+        self.registry.gauge("transfer_gate_active", lambda: self.transfer_gate.active)
+        # Latest obs.metrics reply per member, scraped by the leader on the
+        # probe cadence (empty on non-leading nodes).
+        self.fleet_metrics: dict[str, dict] = {}
 
         # --- L1 membership over UDP gossip -----------------------------
         self.gossip = UdpTransport(config.host, config.gossip_port, auth=self.auth)
         self.membership = MembershipNode(config, self.gossip, self.clock)
 
         # --- member services (SDFS store + inference worker) -----------
-        self.store = MemberStore(Path(config.storage_dir))
+        self.store = MemberStore(Path(config.storage_dir), flight=self.flight)
+        self.registry.gauge(
+            "sdfs_blobs",
+            lambda: sum(len(vs) for vs in self.store.listing().values()),
+        )
         self.sdfs_member = SdfsMember(
             self.store,
             self.rpc,
@@ -156,18 +179,25 @@ class ClusterNode:
                 }
         self.worker = PredictWorker(backends, gate=self.predict_gate)
         self.model_loader = ModelLoader(self.store, self.worker.backends)
-        methods = {
+        self.obs = ObsService(self.registry, flight=self.flight, lane=self.lane)
+        methods = traced_methods({
             **self.sdfs_member.methods(),
             **self.worker.methods(),
             **self.model_loader.methods(),
+            **self.obs.methods(),
             "node.info": self._node_info,
             "node.status": lambda p: self.status(remote=False),
-        }
+        })
         self.member_server = TcpRpcServer(
             config.host, config.member_port, methods, auth=self.auth,
-            metrics=self.metrics,
+            metrics=self.metrics, lane=self.lane,
         )
         self.self_member_addr = self.member_server.address
+        if self.self_member_addr != self.lane:  # OS-assigned port (port 0)
+            self.lane = self.self_member_addr
+            self.flight.node = self.lane
+            self.obs.lane = self.lane
+            self.member_server.lane = self.lane
 
         # --- leader-candidate machinery --------------------------------
         candidates = config.leader_candidates or [f"{config.host}:{config.leader_port}"]
@@ -231,9 +261,13 @@ class ClusterNode:
                     # sheds with Overloaded (docs/OVERLOAD.md).
                     max_queue=config.predict_max_queue,
                     metrics=self.metrics,
+                    flight=self.flight,
                 )
                 self.worker.backends[name] = wrapped
                 self._batchers.append(wrapped)
+                self.registry.gauge(
+                    f"microbatch_queue_{name}", lambda b=wrapped: len(b._queue)
+                )
 
     # ---- leader side ---------------------------------------------------
 
@@ -273,8 +307,21 @@ class ClusterNode:
             gray_min_latency_s=self.config.gray_min_latency_s,
             gray_probe_interval_s=self.config.gray_probe_interval_s,
             metrics=self.metrics,
+            flight=self.flight,
         )
-        methods = {**self.sdfs_leader.methods(), **self.scheduler.methods()}
+        methods = {
+            **self.sdfs_leader.methods(),
+            **self.scheduler.methods(),
+            # Fleet-wide observability read-outs: the latest obs.metrics
+            # snapshot per member (scraped by _obs_scrape_loop while
+            # leading), raw and as Prometheus text.
+            **traced_methods({
+                "obs.fleet": lambda p: {"fleet": dict(self.fleet_metrics)},
+                "obs.fleet_prom": lambda p: {
+                    "text": observe.render_fleet_prometheus(dict(self.fleet_metrics))
+                },
+            }),
+        }
         if self.config.mesh_processes > 1:
             from dmlc_tpu.parallel.multihost import MeshBootstrap
 
@@ -286,7 +333,7 @@ class ClusterNode:
             methods.update(self.mesh_bootstrap.methods())
         self.leader_server = TcpRpcServer(
             self.config.host, self.config.leader_port, methods, auth=self.auth,
-            metrics=self.metrics,
+            metrics=self.metrics, lane=self.lane,
         )
         # Leadership is claimed via StandbyLeader.step(), never assumed at
         # boot: a restarted ex-leader must defer to whoever promoted while
@@ -387,14 +434,28 @@ class ClusterNode:
         if self.is_candidate:
             self._spawn(self._heal_loop)
             self._spawn(self._assign_loop)
+            self._spawn(self._obs_scrape_loop)
             for _ in range(max(1, self.config.dispatch_workers)):
                 self._spawn(self._dispatch_loop)
             self._spawn(self._standby_loop)
 
     def _spawn(self, fn) -> None:
-        t = threading.Thread(target=fn, daemon=True, name=fn.__name__)
+        def run() -> None:
+            # Every span a maintenance thread records (dispatch, heal,
+            # probes) attributes to this node's lane in fleet traces.
+            with tracing.lane(self.lane):
+                fn()
+
+        t = threading.Thread(target=run, daemon=True, name=fn.__name__)
         t.start()
         self._threads.append(t)
+
+    def flight_dump_path(self) -> Path:
+        """Where this node's flight-recorder ring lands on crash/stop —
+        a sibling of the storage dir, so postmortems of a wiped node still
+        find it."""
+        base = Path(self.config.storage_dir)
+        return base.parent / (base.name + ".flight.json")
 
     def stop(self) -> None:
         self._stop.set()
@@ -406,12 +467,23 @@ class ClusterNode:
         if self.leader_server is not None:
             self.leader_server.close()
         self.gossip.close()
+        self.flight.note("node_stop")
+        self.flight.dump(self.flight_dump_path(), reason="stop")
 
     def _loop(self, interval: float, body) -> None:
         while not self._stop.is_set():
             try:
                 body()
-            except Exception:
+            except Exception as e:
+                # A crashed maintenance loop is exactly the moment the ring
+                # must survive: record the transition and dump to disk so a
+                # postmortem has the (bounded) event history leading up.
+                self.flight.note(
+                    "loop_error",
+                    loop=getattr(body, "__qualname__", str(body)),
+                    error=f"{type(e).__name__}: {e}",
+                )
+                self.flight.dump(self.flight_dump_path(), reason="loop_error")
                 log.exception("maintenance loop error")
             self._stop.wait(interval)
 
@@ -469,6 +541,9 @@ class ClusterNode:
         def body():
             _, corrupt = self.store.scrub_once(self.config.scrub_batch)
             for name, version in corrupt:
+                # The quarantine itself is already in the ring (MemberStore
+                # notes it); this records the scrub VERDICT + report hop.
+                self.flight.note("scrub_corrupt", name=name, version=int(version))
                 self.sdfs.report_corrupt(name, version, self.self_member_addr)
 
         self._loop(self.config.scrub_interval_s, body)
@@ -516,6 +591,22 @@ class ClusterNode:
 
     def _standby_loop(self):
         self._loop(self.config.leader_probe_interval_s, self.standby.step)
+
+    def _obs_scrape_loop(self):
+        """Leader-side fleet metrics scrape (docs/OBSERVABILITY.md): while
+        leading, pull every active member's ``obs.metrics`` on the probe
+        cadence and keep the latest reply — ``obs.fleet``/``obs.fleet_prom``
+        and the CLI ``metrics fleet`` verb read from here."""
+
+        def body():
+            self.fleet_metrics = observe.scrape_fleet_metrics(
+                self.rpc, self.active_member_addrs(), timeout=2.0
+            )
+
+        self._loop(
+            self.config.leader_probe_interval_s,
+            lambda: self._if_leading(body),
+        )
 
     def _if_leading(self, fn):
         if self.standby is not None and self.standby.is_leader:
@@ -651,6 +742,7 @@ class ClusterNode:
                 "transfer": self.transfer_gate.summary(),
             },
             "breakers": self.retry_policy.snapshot(),
+            "flight_recorded": self.flight.to_wire()["recorded"],
         }
         if self._batchers:
             out["microbatch"] = {

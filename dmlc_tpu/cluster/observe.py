@@ -1,0 +1,268 @@
+"""Observability plane: the ``obs.*`` RPC surface + the leader-side merge.
+
+Three fleet-wide capabilities over the existing RPC fabrics
+(docs/OBSERVABILITY.md):
+
+- **Metrics scrape** — ``obs.metrics`` returns one node's whole metric
+  surface (utils/metrics.Registry snapshot + tracer span aggregates); the
+  leader scrapes every active member on the probe cadence and
+  ``render_fleet_prometheus`` exposes the lot as Prometheus text with a
+  ``node`` label per member.
+- **Distributed trace collection** — ``obs.trace_dump`` returns a node's
+  raw spans (trace/span/parent ids included) in its OWN tracer timebase;
+  ``measure_clock_offset`` aligns that timebase to the collector's via an
+  NTP-style midpoint over ``obs.clock`` (offset = remote_now - (t0+t1)/2,
+  best-of-N by minimum RTT, so the error is bounded by the best RTT/2);
+  ``merge_fleet_trace`` emits ONE Chrome/Perfetto trace with one pid lane
+  per node and clock-aligned timestamps, with child spans clamped to start
+  no earlier than their parent (residual sub-RTT skew must not render
+  causality backwards).
+- **Flight recorder fetch** — ``obs.flight`` returns the node's bounded
+  event ring (cluster/flight.py) for live postmortems.
+
+``obs.trace_ctl`` starts/stops/resets tracing remotely, so one CLI can arm
+the whole fleet before reproducing an incident.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+
+from dmlc_tpu.cluster.rpc import Rpc, RpcError, RpcUnreachable
+from dmlc_tpu.utils.metrics import Registry, render_prometheus
+from dmlc_tpu.utils.tracing import traced_methods, tracer
+
+log = logging.getLogger(__name__)
+
+
+class ObsService:
+    """One node's observability RPC surface (registered on the member
+    server next to the SDFS/worker verbs). ``lane`` is the node's member
+    address — ``obs.trace_dump`` filters the process-global tracer to spans
+    this node executed, so co-hosted nodes (the localcluster harness) each
+    report their own timeline."""
+
+    def __init__(self, registry: Registry, flight=None, lane: str | None = None):
+        self.registry = registry
+        self.flight = flight
+        self.lane = lane
+
+    def methods(self) -> dict:
+        return traced_methods({
+            "obs.metrics": self._metrics,
+            "obs.clock": self._clock,
+            "obs.trace_dump": self._trace_dump,
+            "obs.trace_ctl": self._trace_ctl,
+            "obs.flight": self._flight,
+        })
+
+    def _metrics(self, p: dict) -> dict:
+        return {"metrics": self.registry.snapshot(), "spans": tracer.summary()}
+
+    def _clock(self, p: dict) -> dict:
+        # The tracer's own clock — the timebase every span timestamp lives
+        # in — NOT wall time: host clocks are never compared directly.
+        return {"now": tracer.now()}
+
+    def _trace_dump(self, p: dict) -> dict:
+        return {
+            "events": tracer.events_wire(lane=self.lane),
+            "now": tracer.now(),
+            "dropped": tracer.dropped_events,
+            "lane": self.lane,
+        }
+
+    def _trace_ctl(self, p: dict) -> dict:
+        if p.get("reset"):
+            tracer.reset()
+        if "enable" in p:
+            tracer.enabled = bool(p["enable"])
+        return {"enabled": tracer.enabled}
+
+    def _flight(self, p: dict) -> dict:
+        if self.flight is None:
+            return {"events": [], "recorded": 0, "dropped": 0, "capacity": 0}
+        return self.flight.to_wire()
+
+
+# ---------------------------------------------------------------------------
+# Leader-side collection + merge
+# ---------------------------------------------------------------------------
+
+
+def measure_clock_offset(
+    rpc: Rpc, addr: str, local_now, samples: int = 5, timeout: float = 2.0
+) -> tuple[float, float]:
+    """NTP-style offset of ``addr``'s tracer clock relative to ours:
+    ``remote ≈ local + offset``. Each probe brackets the remote read with
+    two local reads and assumes symmetric transit (the midpoint); the probe
+    with the smallest round trip wins, bounding the error by best-RTT/2.
+    Returns ``(offset_s, best_rtt_s)``."""
+    best: tuple[float, float] | None = None  # (rtt, offset)
+    for _ in range(max(1, samples)):
+        t0 = local_now()
+        remote = float(rpc.call(addr, "obs.clock", {}, timeout=timeout)["now"])
+        t1 = local_now()
+        rtt = t1 - t0
+        offset = remote - (t0 + t1) / 2.0
+        if best is None or rtt < best[0]:
+            best = (rtt, offset)
+    return best[1], best[0]
+
+
+def collect_fleet_trace(
+    rpc: Rpc, addrs: list[str], timeout: float = 10.0, clock_samples: int = 5
+) -> dict:
+    """Pull every node's span dump + clock offset and merge them into one
+    Chrome/Perfetto trace document. Unreachable nodes are skipped (named in
+    ``otherData.unreachable``) — a partial fleet trace beats none."""
+    per_node: dict[str, dict] = {}
+    unreachable: dict[str, str] = {}
+    for addr in addrs:
+        try:
+            offset, rtt = measure_clock_offset(
+                rpc, addr, local_now=tracer.now, samples=clock_samples,
+                timeout=timeout,
+            )
+            dump = rpc.call(addr, "obs.trace_dump", {}, timeout=timeout)
+            per_node[addr] = {"dump": dump, "offset": offset, "rtt": rtt}
+        except (RpcUnreachable, RpcError) as e:
+            unreachable[addr] = str(e)
+            log.warning("fleet trace: %s unreachable: %s", addr, e)
+    return merge_fleet_trace(per_node, unreachable=unreachable)
+
+
+def merge_fleet_trace(per_node: dict, unreachable: dict | None = None) -> dict:
+    """Merge per-node dumps (``{addr: {"dump": obs.trace_dump reply,
+    "offset": s, "rtt": s}}``) into one trace-event document: one pid per
+    node (process_name metadata = its address), every timestamp translated
+    into the collector's timebase (``local = remote - offset``), and child
+    spans clamped to start no earlier than their parent — the residual
+    skew after alignment is sub-RTT, and a child rendered before its parent
+    would read as causality violated when it is only clock noise."""
+    events: list[dict] = []
+    meta: list[dict] = []
+    dropped_total = 0
+    span_start: dict[str, float] = {}  # span_id -> aligned start (seconds)
+    parsed: list[tuple[int, dict, float]] = []
+    for pid, (addr, entry) in enumerate(sorted(per_node.items())):
+        offset = float(entry.get("offset", 0.0))
+        dump = entry["dump"]
+        dropped_total += int(dump.get("dropped", 0))
+        meta.append({
+            "name": "process_name", "ph": "M", "pid": pid,
+            "args": {"name": addr},
+        })
+        for e in dump.get("events", ()):
+            start = float(e["start"]) - offset
+            parsed.append((pid, e, start))
+            if e.get("span"):
+                # First writer wins: a span id is unique, but co-hosted
+                # nodes can both report an unlaned span.
+                span_start.setdefault(e["span"], start)
+    clamped = 0
+    for pid, e, start in parsed:
+        parent = e.get("parent")
+        if parent is not None and parent in span_start:
+            floor = span_start[parent]
+            if start < floor:
+                start = floor
+                clamped += 1
+        args = dict(e.get("attrs") or {})
+        for key in ("trace", "span", "parent", "lane"):
+            if e.get(key) is not None:
+                args[key] = e[key]
+        events.append({
+            "name": e["name"],
+            "ph": "X",
+            "ts": start * 1e6,
+            "dur": float(e["dur"]) * 1e6,
+            "pid": pid,
+            "tid": int(e.get("tid", 0)),
+            "args": args,
+        })
+    other: dict = {
+        "nodes": {a: {"offset_s": v.get("offset"), "rtt_s": v.get("rtt")}
+                  for a, v in sorted(per_node.items())},
+        "skew_clamped_children": clamped,
+    }
+    if dropped_total:
+        other["dropped_events"] = dropped_total
+        other["note"] = "one or more nodes truncated their span buffer"
+    if unreachable:
+        other["unreachable"] = dict(unreachable)
+    return {"traceEvents": meta + events, "otherData": other}
+
+
+def export_fleet_trace(
+    rpc: Rpc, addrs: list[str], path: str | Path, timeout: float = 10.0
+) -> dict:
+    """Collect + write one merged fleet trace; returns the document."""
+    from dmlc_tpu.cluster.diskio import atomic_write
+
+    doc = collect_fleet_trace(rpc, addrs, timeout=timeout)
+    # Atomic even though this is an operator artifact: a half-written trace
+    # looks exactly like a Perfetto parser bug to the person debugging.
+    atomic_write(Path(path), json.dumps(doc).encode())
+    return doc
+
+
+def set_fleet_tracing(
+    rpc: Rpc, addrs: list[str], enable: bool, reset: bool = False,
+    timeout: float = 2.0,
+) -> dict[str, bool]:
+    """Flip tracing on every reachable node (best-effort; returns
+    {addr: reached})."""
+    out: dict[str, bool] = {}
+    for addr in addrs:
+        try:
+            rpc.call(
+                addr, "obs.trace_ctl", {"enable": enable, "reset": reset},
+                timeout=timeout,
+            )
+            out[addr] = True
+        except (RpcUnreachable, RpcError) as e:
+            out[addr] = False
+            log.warning("trace_ctl %s failed: %s", addr, e)
+    return out
+
+
+def scrape_fleet_metrics(
+    rpc: Rpc, addrs: list[str], timeout: float = 2.0
+) -> dict[str, dict]:
+    """One scrape pass: every reachable node's ``obs.metrics`` reply.
+    The leader runs this on the probe cadence (cluster/node.py) and keeps
+    the latest reply per member."""
+    out: dict[str, dict] = {}
+    for addr in addrs:
+        try:
+            out[addr] = rpc.call(addr, "obs.metrics", {}, timeout=timeout)
+        except (RpcUnreachable, RpcError) as e:
+            log.debug("metrics scrape %s failed: %s", addr, e)
+    return out
+
+
+def render_fleet_prometheus(fleet: dict[str, dict], prefix: str = "dmlc") -> str:
+    """Prometheus text for a whole fleet's scraped snapshots, one ``node``
+    label per member."""
+    chunks = []
+    for addr, reply in sorted(fleet.items()):
+        snap = reply.get("metrics") or {}
+        chunks.append(render_prometheus(
+            snap, prefix=prefix, labels=f'node="{addr}"'
+        ))
+    return "".join(chunks)
+
+
+__all__ = [
+    "ObsService",
+    "collect_fleet_trace",
+    "export_fleet_trace",
+    "measure_clock_offset",
+    "merge_fleet_trace",
+    "render_fleet_prometheus",
+    "scrape_fleet_metrics",
+    "set_fleet_tracing",
+]

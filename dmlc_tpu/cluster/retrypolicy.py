@@ -89,6 +89,7 @@ class RetryPolicy:
         retry_rate_per_s: float = 1.0,
         retry_burst: float = 5.0,
         metrics=None,
+        flight=None,
     ):
         self.clock = clock
         self.breaker_threshold = max(1, int(breaker_threshold))
@@ -96,6 +97,9 @@ class RetryPolicy:
         self.retry_rate_per_s = float(retry_rate_per_s)
         self.retry_burst = float(retry_burst)
         self.metrics = metrics
+        # Flight recorder (cluster/flight.py, optional): breaker open/close
+        # transitions, timestamped for postmortems.
+        self.flight = flight
         self._breakers: dict[str, _Breaker] = {}
         self._buckets: dict[str, _Bucket] = {}
         self._lock = threading.Lock()
@@ -166,25 +170,33 @@ class RetryPolicy:
         toward opening it (and re-open a half-open one immediately)."""
         failure = err is not None and is_overload_error(err)
         opened = False
+        closed = False
         with self._lock:
             b = self._breakers.setdefault(dest, _Breaker())
             if not failure:
+                closed = b.state != _Breaker.CLOSED
                 b.state = _Breaker.CLOSED
                 b.consec = 0
                 b.probe_inflight = False
-                return
-            b.consec += 1
-            b.probe_inflight = False
-            if b.state == _Breaker.HALF_OPEN or b.consec >= self.breaker_threshold:
-                if b.state != _Breaker.OPEN:
-                    b.open_count += 1
-                    opened = True
-                b.state = _Breaker.OPEN
-                b.opened_at = self.clock()
+            else:
+                b.consec += 1
+                b.probe_inflight = False
+                if b.state == _Breaker.HALF_OPEN or b.consec >= self.breaker_threshold:
+                    if b.state != _Breaker.OPEN:
+                        b.open_count += 1
+                        opened = True
+                    b.state = _Breaker.OPEN
+                    b.opened_at = self.clock()
         if opened:
             if self.metrics is not None:
                 self.metrics.inc("breaker_open")
+            if self.flight is not None:
+                self.flight.note("breaker_open", dest=dest, error=str(err))
             log.warning("circuit breaker OPEN for %s (%s)", dest, err)
+        elif closed:
+            if self.flight is not None:
+                self.flight.note("breaker_close", dest=dest)
+            log.info("circuit breaker closed for %s", dest)
 
     # ---- introspection -------------------------------------------------
 

@@ -40,7 +40,9 @@ from typing import Callable
 import msgpack
 
 from dmlc_tpu.cluster import deadline as deadline_mod
+from dmlc_tpu.cluster import tracectx
 from dmlc_tpu.cluster.auth import AuthError, FrameAuth
+from dmlc_tpu.utils import tracing
 
 log = logging.getLogger(__name__)
 
@@ -122,26 +124,35 @@ def serve_with_deadline(
     payload: dict,
     budget_s: float | None,
     clock: Callable[[], float],
+    trace=None,
+    lane: str | None = None,
 ) -> dict:
     """Server-side dispatch under the caller's propagated budget: refuse
     work that arrives already expired, bind the deadline ambiently so
     nested calls inherit it, and refuse to *return* a result the caller has
     already given up on (the reply would be dead bytes; the caller must see
-    the same verdict its own clock reached)."""
-    if budget_s is None:
-        return _dispatch(methods, method, payload)
-    budget_s = float(budget_s)
-    if budget_s <= 0:
-        raise DeadlineExceeded(f"{method}: budget exhausted on arrival")
-    dl = deadline_mod.Deadline(budget_s, clock=clock)
-    with deadline_mod.bind(dl):
-        reply = _dispatch(methods, method, payload)
-    if dl.expired():
-        raise DeadlineExceeded(
-            f"{method}: finished {-dl.remaining():.3f}s past its "
-            f"{budget_s:.3f}s deadline"
-        )
-    return reply
+    the same verdict its own clock reached).
+
+    ``trace`` is the frame's ``t`` field (cluster/tracectx.py): it is bound
+    ambiently — INCLUDING the None case, which clears any context inherited
+    on the caller's stack, so the sim fabric propagates exactly what the
+    wire carries and nothing more. ``lane`` is the serving node's identity,
+    bound so every span the handler opens attributes to this node."""
+    with tracing.lane(lane), tracectx.bind(tracectx.from_wire(trace)):
+        if budget_s is None:
+            return _dispatch(methods, method, payload)
+        budget_s = float(budget_s)
+        if budget_s <= 0:
+            raise DeadlineExceeded(f"{method}: budget exhausted on arrival")
+        dl = deadline_mod.Deadline(budget_s, clock=clock)
+        with deadline_mod.bind(dl):
+            reply = _dispatch(methods, method, payload)
+        if dl.expired():
+            raise DeadlineExceeded(
+                f"{method}: finished {-dl.remaining():.3f}s past its "
+                f"{budget_s:.3f}s deadline"
+            )
+        return reply
 
 
 class SimRpcNetwork(Rpc):
@@ -164,6 +175,10 @@ class SimRpcNetwork(Rpc):
         self.down: set[str] = set()
         self.cut: set[tuple[str, str]] = set()
         self.calls: list[tuple[str, str]] = []  # (addr, method) trace for tests
+        # Frame METADATA per call ({"m", "d"} + "t" when present — payload
+        # deliberately excluded so soak tests don't pin every transferred
+        # blob in memory), for tests that assert on the wire format.
+        self.frames: list[dict] = []
         self.now = 0.0                          # virtual clock (seconds)
         self.latency: dict[tuple[str, str], float] = {}  # (src, dst) -> s
 
@@ -232,9 +247,19 @@ class SimRpcNetwork(Rpc):
                 f"{addr}: no reply within {budget:.3f}s (link latency {lat:.3f}s)"
             )
         self.now += lat
+        # The frame as the TCP fabric would build it: `t` is present only
+        # when a trace context is ambient (tracing disabled or no open span
+        # -> no field -> zero frame bytes), and the server re-binds FROM the
+        # frame, never from the caller's stack.
+        frame: dict = {"m": method, "d": budget - lat}
+        t = tracectx.wire_context()
+        if t is not None:
+            frame["t"] = t
+        self.frames.append(frame)
         try:
             return serve_with_deadline(
-                self.services[addr], method, payload, budget - lat, clock=self.clock
+                self.services[addr], method, payload, budget - lat,
+                clock=self.clock, trace=frame.get("t"), lane=addr,
             )
         except RpcError:
             raise
@@ -321,7 +346,8 @@ class TcpRpcServer:
     ``metrics`` (utils/metrics.Counters, optional) counts the
     ``deadline_exceeded`` verdicts this server hands out (budget ran out on
     arrival or during execution); sheds are counted by the admission gates
-    that raise them."""
+    that raise them. ``lane`` is the owning node's identity
+    (utils/tracing.lane): spans recorded while serving attribute to it."""
 
     def __init__(
         self,
@@ -330,10 +356,12 @@ class TcpRpcServer:
         methods: dict[str, Method],
         auth: FrameAuth | None = None,
         metrics=None,
+        lane: str | None = None,
     ):
         self.methods = methods
         self.auth = auth
         self.metrics = metrics
+        self.lane = lane
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind((host, port))
@@ -374,7 +402,8 @@ class TcpRpcServer:
                     # so a recorded reply cannot be replayed to anyone else.
                     try:
                         reply = serve_with_deadline(
-                            self.methods, req["m"], req["p"], req.get("d"), clock=_now
+                            self.methods, req["m"], req["p"], req.get("d"),
+                            clock=_now, trace=req.get("t"), lane=self.lane,
                         )
                         _send_frame(conn, {"ok": True, "r": reply}, self.auth, recipient=peer)
                     except Exception as e:  # method error -> remote RpcError
@@ -450,13 +479,15 @@ class TcpRpc(Rpc):
                     raise RpcUnreachable(f"{addr}: connect consumed the whole budget")
                 sock.settimeout(left)
                 # The server's budget is what remains NOW, not the original
-                # timeout — the connect phase already spent its share.
-                _send_frame(
-                    sock,
-                    {"m": method, "p": payload, "d": left},
-                    self.auth,
-                    recipient=addr,
-                )
+                # timeout — the connect phase already spent its share. The
+                # trace context (if any span is open here) rides as `t`;
+                # with tracing off no span binds one, so the frame carries
+                # zero extra bytes.
+                req: dict = {"m": method, "p": payload, "d": left}
+                t = tracectx.wire_context()
+                if t is not None:
+                    req["t"] = t
+                _send_frame(sock, req, self.auth, recipient=addr)
                 left = remaining()
                 if left <= 0:
                     raise RpcUnreachable(f"{addr}: budget exhausted before the reply")

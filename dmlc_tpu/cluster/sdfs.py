@@ -37,6 +37,7 @@ from dmlc_tpu.cluster import deadline as deadline_lib
 from dmlc_tpu.cluster import diskio
 from dmlc_tpu.cluster.diskio import DiskIo, atomic_copy, atomic_install, atomic_write
 from dmlc_tpu.cluster.rpc import Rpc, RpcError, RpcUnreachable
+from dmlc_tpu.utils.tracing import traced_methods, tracer
 
 log = logging.getLogger(__name__)
 
@@ -106,9 +107,12 @@ class MemberStore:
     heals onto another member. ``scrub_once`` re-verifies at rest.
     """
 
-    def __init__(self, storage_dir: str | Path, io: DiskIo | None = None):
+    def __init__(self, storage_dir: str | Path, io: DiskIo | None = None, flight=None):
         self.dir = Path(storage_dir)
         self.io = io or diskio.DEFAULT_IO
+        # Flight recorder (cluster/flight.py, optional): quarantines are
+        # exactly the state transitions postmortems need timestamps for.
+        self.flight = flight
         self.dir.mkdir(parents=True, exist_ok=True)
         # Scratch spaces hold only in-flight state a crash abandons; they
         # ARE wiped at boot. Quarantined copies are corrupt by definition —
@@ -312,6 +316,8 @@ class MemberStore:
             if src.exists():
                 src.replace(self._quarantine_dir / f"{tag}.{fname.lstrip('.')}")
         log.warning("quarantined %s v%s (failed digest verification)", name, version)
+        if self.flight is not None:
+            self.flight.note("quarantine", name=name, version=int(version))
         return True
 
     def scrub_once(self, max_blobs: int | None = None) -> tuple[int, list[tuple[str, int]]]:
@@ -467,7 +473,7 @@ class SdfsMember:
             return {"epoch": list(self._fence) if self._fence else None}
 
     def methods(self) -> dict:
-        return {
+        return traced_methods({
             "sdfs.fence": self._fence_rpc,
             "sdfs.receive": self._receive,
             "sdfs.fetch": self._fetch,
@@ -479,7 +485,7 @@ class SdfsMember:
             "sdfs.delete": self._delete,
             "sdfs.store": self._store,
             "sdfs.scrub": self._scrub,
-        }
+        })
 
     def _receive(self, p: dict) -> dict:
         self._check_epoch(p)
@@ -716,7 +722,7 @@ class SdfsLeader:
         self._tombstones: dict[str, int] = {}
 
     def methods(self) -> dict:
-        return {
+        return traced_methods({
             "sdfs.put": self._put,
             "sdfs.put_inline": self._put_inline,
             "sdfs.get": self._get,
@@ -727,7 +733,7 @@ class SdfsLeader:
             "sdfs.state": self._state_wire,
             "sdfs.announce": self._announce,
             "sdfs.report_corrupt": self._report_corrupt,
-        }
+        })
 
     def _require_leading(self) -> None:
         if not self.is_leading:
@@ -1346,44 +1352,49 @@ class SdfsClient:
                     continue
             hasher = hashlib.sha256()
             transfer = deadline_lib.Deadline(self.transfer_timeout_s)
-            try:
-                size = int(
-                    self.rpc.call(
-                        r, "sdfs.fetch_meta", {"name": name, "version": version},
-                        timeout=30.0, deadline=transfer,
-                    )["size"]
-                )
-                f.seek(start)
-                f.truncate(start)
-                for offset in range(0, size, self.chunk_bytes):
-                    part = self.rpc.call(
-                        r,
-                        "sdfs.fetch_chunk",
-                        {
-                            "name": name,
-                            "version": version,
-                            "offset": offset,
-                            "length": min(self.chunk_bytes, size - offset),
-                        },
-                        timeout=self.transfer_timeout_s,
-                        deadline=transfer,
-                    )["data"]
-                    hasher.update(part)
-                    f.write(part)
-                if digest is not None and hasher.hexdigest() != digest:
-                    raise IntegrityError(
-                        f"replica {r} served {name} v{version} with digest "
-                        f"{hasher.hexdigest()[:12]} != expected {digest[:12]}"
+            # One client-side span per replica attempt: the fleet trace
+            # shows WHERE the bytes came from (and which fallbacks were
+            # tried) as children of whatever request pulled them.
+            with tracer.span("sdfs/pull", blob=name, version=int(version), replica=r):
+                try:
+                    size = int(
+                        self.rpc.call(
+                            r, "sdfs.fetch_meta", {"name": name, "version": version},
+                            timeout=30.0, deadline=transfer,
+                        )["size"]
                     )
-                if self.retry_policy is not None:
-                    self.retry_policy.record(r)
-                return
-            except (RpcUnreachable, RpcError) as e:
-                if self.retry_policy is not None:
-                    self.retry_policy.record(r, e)
-                if is_integrity_error(e):
-                    # Either we hashed a mismatch, or the member's own read
-                    # verification tripped — in both cases that copy is rot.
-                    self.report_corrupt(name, version, r)
-                last = e
+                    f.seek(start)
+                    f.truncate(start)
+                    for offset in range(0, size, self.chunk_bytes):
+                        part = self.rpc.call(
+                            r,
+                            "sdfs.fetch_chunk",
+                            {
+                                "name": name,
+                                "version": version,
+                                "offset": offset,
+                                "length": min(self.chunk_bytes, size - offset),
+                            },
+                            timeout=self.transfer_timeout_s,
+                            deadline=transfer,
+                        )["data"]
+                        hasher.update(part)
+                        f.write(part)
+                    if digest is not None and hasher.hexdigest() != digest:
+                        raise IntegrityError(
+                            f"replica {r} served {name} v{version} with digest "
+                            f"{hasher.hexdigest()[:12]} != expected {digest[:12]}"
+                        )
+                    if self.retry_policy is not None:
+                        self.retry_policy.record(r)
+                    return
+                except (RpcUnreachable, RpcError) as e:
+                    if self.retry_policy is not None:
+                        self.retry_policy.record(r, e)
+                    if is_integrity_error(e):
+                        # Either we hashed a mismatch, or the member's own
+                        # read verification tripped — in both cases that
+                        # copy is rot.
+                        self.report_corrupt(name, version, r)
+                    last = e
         raise RpcError(f"no live replica served {name!r} v{version}: {last}")

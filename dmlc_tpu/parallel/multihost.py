@@ -27,6 +27,7 @@ import threading
 import time
 
 from dmlc_tpu.cluster.rpc import Rpc, RpcError
+from dmlc_tpu.utils.tracing import traced_methods
 
 log = logging.getLogger(__name__)
 
@@ -56,11 +57,11 @@ class MeshBootstrap:
         self._lock = threading.Lock()
 
     def methods(self) -> dict:
-        return {
+        return traced_methods({
             "mesh.register": self._register,
             "mesh.info": self._info,
             "mesh.state": self._state_wire,
-        }
+        })
 
     def _state_wire(self, p: dict) -> dict:
         """Rank-map replication payload for standby leaders: without it a

@@ -46,7 +46,7 @@ from dmlc_tpu.cluster.rpc import (
 )
 from dmlc_tpu.scheduler.worker import gang_slice
 from dmlc_tpu.utils.metrics import Counters, LatencyStats
-from dmlc_tpu.utils.tracing import tracer
+from dmlc_tpu.utils.tracing import traced_methods, tracer
 
 log = logging.getLogger(__name__)
 
@@ -211,6 +211,7 @@ class JobScheduler:
         gray_min_latency_s: float = 0.25,
         gray_probe_interval_s: float = 5.0,
         metrics: Counters | None = None,
+        flight=None,
     ):
         import time
 
@@ -236,6 +237,10 @@ class JobScheduler:
         self.gray_min_latency_s = float(gray_min_latency_s)
         self.gray_probe_interval_s = float(gray_probe_interval_s)
         self.metrics = metrics if metrics is not None else Counters()
+        # Flight recorder (cluster/flight.py, optional): demotions,
+        # restorations, and gang job stops are the transitions a postmortem
+        # reconstructs first.
+        self.flight = flight
         # member addr -> {"ewma", "demoted", "reason", "last_probe",
         # "opens_mark"} (leader-local; a new leader re-learns the fleet).
         self._health: dict[str, dict] = {}
@@ -292,7 +297,7 @@ class JobScheduler:
     # ---- RPC surface ---------------------------------------------------
 
     def methods(self) -> dict:
-        return {
+        return traced_methods({
             "job.start": self._start_rpc,
             "job.report": self._report,
             "job.state": self._state,
@@ -303,7 +308,7 @@ class JobScheduler:
                 "epoch": list(self.epoch),
                 "overload": self.overload_status(),
             },
-        }
+        })
 
     def overload_status(self) -> dict:
         """The overload-control counters and verdicts this leader holds —
@@ -442,6 +447,8 @@ class JobScheduler:
         self.demoted.add(member)
         self.metrics.inc("gray_demotions")
         tracer.record("overload/gray_demote", 0.0, member=member, reason=reason)
+        if self.flight is not None:
+            self.flight.note("gray_demote", member=member, reason=reason, detail=detail)
         log.warning("gray-demoting %s: %s", member, detail)
 
     def _restore(self, member: str) -> None:
@@ -453,6 +460,8 @@ class JobScheduler:
         self.demoted.discard(member)
         self.metrics.inc("gray_restored")
         tracer.record("overload/gray_restore", 0.0, member=member)
+        if self.flight is not None:
+            self.flight.note("gray_restore", member=member)
         log.warning("gray-restoring %s: recovered", member)
 
     def _gray_check(self) -> None:
@@ -802,6 +811,10 @@ class JobScheduler:
                     if job.gang_consec_failures >= self.gang_max_consec_failures:
                         job.running = False
                         job.last_error = f"gang dispatch failing repeatedly: {why}"
+                        if self.flight is not None:
+                            self.flight.note(
+                                "job_stopped", job=job_name, error=job.last_error
+                            )
                         log.error("stopping job %s: %s", job_name, job.last_error)
             return 0
 

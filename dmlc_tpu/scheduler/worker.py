@@ -25,7 +25,7 @@ from typing import Callable, Protocol, Sequence
 from dmlc_tpu.cluster.rpc import Overloaded, RpcError
 from dmlc_tpu.utils.hotpath import hot_path
 from dmlc_tpu.utils.metrics import LatencyStats
-from dmlc_tpu.utils.tracing import tracer
+from dmlc_tpu.utils.tracing import traced_methods, tracer
 
 log = logging.getLogger(__name__)
 
@@ -70,10 +70,12 @@ class DynamicBatcher:
         name: str = "microbatch",
         max_queue: int = 0,
         metrics=None,
+        flight=None,
     ):
         # _predict is set FIRST: __getattr__ delegates to it, and any
         # attribute probe before it exists would recurse.
         self._predict = predict
+        self.flight = flight
         self.batch_size = int(batch_size)
         self.max_wait_s = float(max_wait_s)
         if self.batch_size <= 0:
@@ -111,6 +113,9 @@ class DynamicBatcher:
                 if self.metrics is not None:
                     self.metrics.inc("shed")
                     self.metrics.inc("shed_microbatch")
+                if self.flight is not None:
+                    self.flight.note("shed", gate=self._thread.name,
+                                     active=len(self._queue))
                 raise Overloaded(
                     f"microbatch queue full ({len(self._queue)}/{self.max_queue})",
                     retry_after_s=self.max_wait_s,
@@ -233,11 +238,11 @@ class PredictWorker:
         self.gate = gate
 
     def methods(self) -> dict:
-        return {
+        return traced_methods({
             "job.predict": self._predict,
             "job.predict_gang": self._predict_gang,
             "job.decode_gang": self._decode_gang,
-        }
+        })
 
     def _decode_gang(self, p: dict) -> dict:
         """Prefetch decode for an upcoming gang shard: the leader calls this
@@ -608,7 +613,7 @@ class ModelLoader:
         self.backends = backends
 
     def methods(self) -> dict:
-        return {"model.load": self._load}
+        return traced_methods({"model.load": self._load})
 
     def _load(self, p: dict) -> dict:
         from dmlc_tpu.models import weights as weights_lib

@@ -17,7 +17,9 @@ from __future__ import annotations
 
 import math
 import random
+import re
 import threading
+from typing import Callable
 
 
 class Counters:
@@ -154,8 +156,42 @@ class LatencyStats:
         self._mean += delta * other.n / n2
         self._m2 += other._m2 + delta * delta * self.n * other.n / n2
         self.n = n2
-        for v in other.reservoir:
-            self._reservoir_offer(v)
+        self._merge_reservoirs(other)
+
+    def _merge_reservoirs(self, other: "LatencyStats") -> None:
+        """WEIGHTED reservoir merge. Each side's reservoir is a uniform
+        sample of ``_offers`` underlying observations. Offering ``other``'s
+        elements one by one into Algorithm R (the old code) ignored that
+        multiplicity and under-weighted any peer whose offer count exceeds
+        its reservoir size — a member that served 100k queries merged like
+        one that served 4k.
+
+        Correct merge: a uniform sample of the UNION stream. When both
+        reservoirs are exact (every offer kept) and fit, the union IS that
+        sample. Otherwise each merged slot picks a side with probability
+        proportional to its offer count and a uniform element from that
+        side's reservoir — expected composition exactly matches the true
+        mixture for any weights (with-replacement within a side is fine:
+        each reservoir already stands in for its whole stream). Drawn from
+        this instance's seeded PRNG so merges stay deterministic."""
+        if not other.reservoir:
+            return
+        mine, theirs = self.reservoir, other.reservoir
+        na, nb = self._offers, other._offers
+        if na == len(mine) and nb == len(theirs) and na + nb <= self.RESERVOIR_SIZE:
+            mine.extend(theirs)
+            self._offers = na + nb
+            return
+        # One side inexact implies its offers exceed RESERVOIR_SIZE, so
+        # na + nb > RESERVOIR_SIZE here and the merged sample is full-size.
+        p_other = nb / (na + nb)
+        self.reservoir = [
+            theirs[self._rng.randrange(len(theirs))]
+            if (not mine or self._rng.random() < p_other)
+            else mine[self._rng.randrange(len(mine))]
+            for _ in range(self.RESERVOIR_SIZE)
+        ]
+        self._offers = na + nb
 
     # ---- wire ----------------------------------------------------------
 
@@ -179,3 +215,104 @@ class LatencyStats:
         out.reservoir = [float(x) for x in w["reservoir"]][: cls.RESERVOIR_SIZE]
         out._offers = int(w.get("offers", len(out.reservoir)))
         return out
+
+
+# ---------------------------------------------------------------------------
+# Registry: one node's whole metric surface behind one snapshot
+# ---------------------------------------------------------------------------
+
+_PROM_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(prefix: str, name: str) -> str:
+    return f"{prefix}_{_PROM_NAME_RE.sub('_', name)}"
+
+
+class Registry:
+    """Unifies a node's ``Counters``, named ``LatencyStats``, and gauges
+    behind ONE snapshot (docs/OBSERVABILITY.md) — the payload of the
+    ``obs.metrics`` RPC the leader scrapes fleet-wide, and the source of
+    the Prometheus text exposition.
+
+    Naming conventions: counters and gauges are ``snake_case`` (gauges
+    suffixed with the thing they measure, e.g. ``predict_gate_active``);
+    latency collectors are ``component/verb`` like span names. Gauges are
+    registered as zero-arg callables read at snapshot time — a gauge whose
+    read raises reports ``None`` rather than failing the scrape.
+    """
+
+    def __init__(self, counters: Counters | None = None):
+        self.counters = counters if counters is not None else Counters()
+        self._latency: dict[str, LatencyStats] = {}
+        self._gauges: dict[str, Callable[[], float]] = {}
+        self._lock = threading.Lock()
+
+    def latency(self, name: str) -> LatencyStats:
+        """The named latency collector, created on first use."""
+        with self._lock:
+            stats = self._latency.get(name)
+            if stats is None:
+                stats = self._latency[name] = LatencyStats()
+            return stats
+
+    def gauge(self, name: str, read: Callable[[], float]) -> None:
+        with self._lock:
+            self._gauges[name] = read
+
+    def snapshot(self) -> dict:
+        """Wire-shaped view of everything: ``{"counters": {...},
+        "gauges": {...}, "latency": {name: summary}}``."""
+        with self._lock:
+            latency = {n: s.summary() for n, s in sorted(self._latency.items())}
+            gauges: dict = {}
+            for name, read in sorted(self._gauges.items()):
+                try:
+                    gauges[name] = float(read())
+                except Exception:
+                    gauges[name] = None  # a broken gauge must not fail the scrape
+        return {"counters": self.counters.snapshot(), "gauges": gauges,
+                "latency": latency}
+
+    def prometheus_text(self, prefix: str = "dmlc", labels: str = "") -> str:
+        """Prometheus text-format exposition of ``snapshot()``. ``labels``
+        is a pre-rendered label body (e.g. ``node="10.0.0.1:8852"``) the
+        fleet exposition uses to distinguish scraped nodes."""
+        return render_prometheus(self.snapshot(), prefix=prefix, labels=labels)
+
+
+def render_prometheus(snapshot: dict, prefix: str = "dmlc", labels: str = "") -> str:
+    """Render one ``Registry.snapshot()``-shaped dict as Prometheus text.
+    Module-level so the leader can render snapshots it scraped off other
+    nodes (cluster/observe.py) identically to local ones."""
+    body = f"{{{labels}}}" if labels else ""
+
+    def qbody(extra: str) -> str:
+        inner = ",".join(x for x in (labels, extra) if x)
+        return f"{{{inner}}}"
+
+    lines: list[str] = []
+    for name, value in sorted((snapshot.get("counters") or {}).items()):
+        metric = _prom_name(prefix, name)
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric}{body} {value}")
+    for name, value in sorted((snapshot.get("gauges") or {}).items()):
+        if value is None:
+            continue
+        metric = _prom_name(prefix, name)
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric}{body} {value}")
+    for name, s in sorted((snapshot.get("latency") or {}).items()):
+        metric = _prom_name(prefix, name) + "_seconds"
+        lines.append(f"# TYPE {metric} summary")
+        for q, key in (("0.5", "median"), ("0.9", "p90"), ("0.95", "p95"),
+                       ("0.99", "p99")):
+            v = s.get(key)
+            if v is not None and not math.isnan(v):
+                qlabel = f'quantile="{q}"'
+                lines.append(f"{metric}{qbody(qlabel)} {v}")
+        count = s.get("count", 0.0)
+        mean = s.get("mean", float("nan"))
+        lines.append(f"{metric}_count{body} {int(count)}")
+        if count and not math.isnan(mean):
+            lines.append(f"{metric}_sum{body} {mean * count}")
+    return "\n".join(lines) + ("\n" if lines else "")

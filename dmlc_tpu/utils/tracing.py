@@ -9,19 +9,35 @@ exports
 - Chrome trace-event JSON (chrome://tracing / Perfetto compatible) for
   timeline inspection of e.g. decode vs device-dispatch overlap.
 
+Spans are DISTRIBUTED (docs/OBSERVABILITY.md): each span records the
+``trace_id``/``span_id``/``parent_id`` of the ambient trace context
+(cluster/tracectx.py), which the RPC fabrics carry hop to hop in the frame
+field ``t`` — so a leader-dispatch span, the member's predict span, and the
+SDFS replica's fetch span all share one trace with correct parent edges,
+and ``obs.trace_dump`` + the leader-side merge (cluster/observe.py) render
+them as one fleet-wide timeline.
+
+``lane`` is the serving-node identity ambient at record time: RPC servers
+bind their node's member address around method execution, so a process
+hosting several nodes (the localcluster harness) can still attribute every
+span to the node that executed it — it becomes the Perfetto pid lane.
+
 Device work is asynchronous under JAX; callers that want true device time
 wrap the block_until_ready boundary (as InferenceEngine.run_batch does).
 """
 
 from __future__ import annotations
 
+import contextvars
 import json
 import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from pathlib import Path
+from typing import Iterator
 
+from dmlc_tpu.cluster import tracectx
 from dmlc_tpu.utils.metrics import LatencyStats
 
 
@@ -32,80 +48,195 @@ class SpanRecord:
     duration_s: float
     thread_id: int
     attrs: dict = field(default_factory=dict)
+    trace_id: str | None = None
+    span_id: str | None = None
+    parent_id: str | None = None
+    lane: str | None = None
+
+
+# ---------------------------------------------------------------------------
+# Lane: which node is executing (ambient; the Perfetto pid dimension)
+# ---------------------------------------------------------------------------
+
+_lane: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "dmlc_trace_lane", default=None
+)
+
+
+def current_lane() -> str | None:
+    return _lane.get()
+
+
+@contextmanager
+def lane(name: str | None) -> Iterator[None]:
+    """Bind the executing-node identity for the dynamic extent of the
+    block. RPC servers bind their node's member address here; node
+    maintenance threads bind it at spawn. None leaves the ambient lane."""
+    if name is None:
+        yield
+        return
+    token = _lane.set(name)
+    try:
+        yield
+    finally:
+        _lane.reset(token)
 
 
 class Tracer:
     """Span collector. Disabled by default; enabling costs one branch per
     span entry. Bounded: keeps aggregates forever, raw events up to
-    ``max_events`` (newest dropped past that, aggregates stay exact)."""
+    ``max_events`` — newest raw spans are dropped past that, aggregates
+    stay exact, and every drop is COUNTED (``dropped_events``) so a
+    truncated timeline is visibly truncated instead of silently short."""
 
     def __init__(self, max_events: int = 100_000):
         self.enabled = False
         self.max_events = max_events
         self._events: list[SpanRecord] = []
+        self._dropped = 0
         self._aggregates: dict[str, LatencyStats] = {}
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
+
+    def now(self) -> float:
+        """The tracer's own clock (seconds since construction/reset) — the
+        timebase every SpanRecord.start_s lives in. ``obs.clock`` echoes
+        this so the leader-side merge can align per-node timelines."""
+        return time.perf_counter() - self._t0
 
     @contextmanager
     def span(self, name: str, **attrs):
         if not self.enabled:
             yield
             return
+        ctx = tracectx.child()
         start = time.perf_counter()
         try:
-            yield
+            with tracectx.bind(ctx):
+                yield
         finally:
             dur = time.perf_counter() - start
-            rec = SpanRecord(name, start - self._t0, dur, threading.get_ident(), attrs)
+            rec = SpanRecord(
+                name, start - self._t0, dur, threading.get_ident(), attrs,
+                trace_id=ctx.trace_id, span_id=ctx.span_id,
+                parent_id=ctx.parent_id, lane=_lane.get(),
+            )
             with self._lock:
                 self._aggregates.setdefault(name, LatencyStats()).record(dur)
-                if len(self._events) < self.max_events:
-                    self._events.append(rec)
+                self._append_locked(rec)
 
     def record(self, name: str, duration_s: float, **attrs) -> None:
-        """Record an externally-timed duration (e.g. device execution)."""
+        """Record an externally-timed duration (e.g. device execution) as a
+        leaf span under the ambient trace context."""
         if not self.enabled:
             return
+        ctx = tracectx.child()
         rec = SpanRecord(
             name, time.perf_counter() - self._t0 - duration_s, duration_s,
             threading.get_ident(), attrs,
+            trace_id=ctx.trace_id, span_id=ctx.span_id,
+            parent_id=ctx.parent_id, lane=_lane.get(),
         )
         with self._lock:
             self._aggregates.setdefault(name, LatencyStats()).record(duration_s)
-            if len(self._events) < self.max_events:
-                self._events.append(rec)
+            self._append_locked(rec)
+
+    def _append_locked(self, rec: SpanRecord) -> None:
+        if len(self._events) < self.max_events:
+            self._events.append(rec)
+        else:
+            self._dropped += 1
 
     # ---- reporting -----------------------------------------------------
 
-    def summary(self) -> dict[str, dict[str, float]]:
+    @property
+    def dropped_events(self) -> int:
         with self._lock:
-            return {name: st.summary() for name, st in sorted(self._aggregates.items())}
+            return self._dropped
+
+    def summary(self) -> dict:
+        """Per-name aggregate summaries. When raw spans were dropped past
+        ``max_events`` the count rides along under the reserved
+        ``dropped_events`` key (absent otherwise, so the common case keeps
+        its pure name->stats shape)."""
+        with self._lock:
+            out: dict = {
+                name: st.summary() for name, st in sorted(self._aggregates.items())
+            }
+            if self._dropped:
+                out["dropped_events"] = self._dropped
+            return out
+
+    def events_wire(self, lane: str | None = None) -> list[dict]:
+        """Raw spans in wire form for ``obs.trace_dump``. With ``lane``
+        given, only spans executed under that lane (plus unlaned spans —
+        in production one process is one node, so ambient work with no
+        serving scope still belongs to it)."""
+        with self._lock:
+            events = list(self._events)
+        out = []
+        for e in events:
+            if lane is not None and e.lane is not None and e.lane != lane:
+                continue
+            out.append(
+                {
+                    "name": e.name,
+                    "start": e.start_s,
+                    "dur": e.duration_s,
+                    "tid": e.thread_id % 1_000_000,
+                    "trace": e.trace_id,
+                    "span": e.span_id,
+                    "parent": e.parent_id,
+                    "lane": e.lane,
+                    "attrs": dict(e.attrs),
+                }
+            )
+        return out
 
     def chrome_trace(self) -> list[dict]:
         """Trace-event JSON objects (phase 'X' = complete events, µs)."""
         with self._lock:
             events = list(self._events)
-        return [
-            {
-                "name": e.name,
-                "ph": "X",
-                "ts": e.start_s * 1e6,
-                "dur": e.duration_s * 1e6,
-                "pid": 0,
-                "tid": e.thread_id % 1_000_000,
-                "args": e.attrs,
-            }
-            for e in events
-        ]
+        out = []
+        for e in events:
+            args = dict(e.attrs)
+            if e.trace_id is not None:
+                args.update(trace=e.trace_id, span=e.span_id)
+                if e.parent_id is not None:
+                    args["parent"] = e.parent_id
+            if e.lane is not None:
+                args["lane"] = e.lane
+            out.append(
+                {
+                    "name": e.name,
+                    "ph": "X",
+                    "ts": e.start_s * 1e6,
+                    "dur": e.duration_s * 1e6,
+                    "pid": 0,
+                    "tid": e.thread_id % 1_000_000,
+                    "args": args,
+                }
+            )
+        return out
 
     def export(self, path: str | Path) -> None:
-        Path(path).write_text(json.dumps({"traceEvents": self.chrome_trace()}))
+        doc: dict = {"traceEvents": self.chrome_trace()}
+        dropped = self.dropped_events
+        if dropped:
+            # Visible truncation: Perfetto shows otherData in the trace
+            # info pane, so a timeline missing its tail says so.
+            doc["otherData"] = {
+                "dropped_events": dropped,
+                "note": f"timeline truncated: {dropped} span(s) past "
+                        f"max_events={self.max_events} were not recorded",
+            }
+        Path(path).write_text(json.dumps(doc))
 
     def reset(self) -> None:
         with self._lock:
             self._events.clear()
             self._aggregates.clear()
+            self._dropped = 0
             self._t0 = time.perf_counter()
 
 
@@ -120,3 +251,35 @@ def enable() -> Tracer:
 
 def disable() -> None:
     tracer.enabled = False
+
+
+# ---------------------------------------------------------------------------
+# RPC handler instrumentation (lint rule O1's contract)
+# ---------------------------------------------------------------------------
+
+
+def traced(method_name: str, fn):
+    """Wrap one RPC handler so it executes under a ``rpc/<method>`` span.
+    The span parents onto the caller's wire context (which the serving
+    layer binds ambiently), so the cross-process edge is recorded here —
+    once, for every handler, instead of per-handler boilerplate. Idempotent:
+    an already-wrapped handler passes through."""
+    if getattr(fn, "_dmlc_traced", False):
+        return fn
+
+    def handler(payload: dict, _fn=fn, _span_name=f"rpc/{method_name}") -> dict:
+        with tracer.span(_span_name):
+            return _fn(payload)
+
+    handler._dmlc_traced = True  # type: ignore[attr-defined]
+    handler.__name__ = getattr(fn, "__name__", method_name)
+    handler.__wrapped__ = fn  # type: ignore[attr-defined]
+    return handler
+
+
+def traced_methods(table: dict) -> dict:
+    """Wrap a whole RPC method table (the form lint rule O1 requires every
+    ``methods()`` to return): each handler runs under its ``rpc/<method>``
+    span. Safe to nest — tables merged from already-traced sub-tables are
+    not double-wrapped."""
+    return {name: traced(name, fn) for name, fn in table.items()}

@@ -597,6 +597,98 @@ def test_r1_suppression_with_justification():
 
 
 # ---------------------------------------------------------------------------
+# O1 — RPC method tables registered without traced_methods
+# ---------------------------------------------------------------------------
+
+
+def test_o1_fires_on_bare_methods_return():
+    src = """
+    class Service:
+        def methods(self):
+            return {
+                "sdfs.fetch": self._fetch,
+                "sdfs.store": self._store,
+            }
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == ["O1"]
+    assert fired(src, "dmlc_tpu/scheduler/x.py") == ["O1"]
+
+
+def test_o1_fires_on_inline_table_at_the_fabric():
+    src = """
+    def boot(net, host, port):
+        net.serve("addr", {"x.go": handler})
+        TcpRpcServer(host, port, {"x.go": handler})
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == ["O1", "O1"]
+
+
+def test_o1_silent_on_traced_methods():
+    src = """
+    from dmlc_tpu.utils.tracing import traced_methods
+
+    class Service:
+        def methods(self):
+            return traced_methods({"sdfs.fetch": self._fetch})
+
+    def boot(net):
+        net.serve("addr", traced_methods({"x.go": handler}))
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == []
+
+
+def test_o1_scope_and_other_functions():
+    src = """
+    class NotAService:
+        def tables(self):
+            return {"not": "an rpc table"}
+
+        def methods(self):
+            return self._cached  # passed by name: out of a file-local rule's reach
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == []
+    bare = """
+    class Service:
+        def methods(self):
+            return {"x.go": self._go}
+    """
+    # tests/ and tools/ register fake services freely.
+    assert fired(bare, "tests/x.py") == []
+    assert fired(bare, "tools/x.py") == []
+
+
+def test_o1_suppression_with_justification():
+    src = """
+    class Service:
+        def methods(self):
+            # dmlc-lint: disable=O1 -- latency-critical heartbeat verbs; spans measured 3% overhead here
+            return {"hb.ping": self._ping}
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == []
+
+
+def test_o1_traced_methods_is_idempotent_and_spans_fire():
+    from dmlc_tpu.cluster import tracectx
+    from dmlc_tpu.utils.tracing import Tracer, traced, traced_methods
+    from dmlc_tpu.utils import tracing as tracing_mod
+
+    calls = []
+    table = traced_methods({"x.go": lambda p: calls.append(p) or {"ok": True}})
+    rewrapped = traced_methods(table)
+    assert rewrapped["x.go"] is table["x.go"]  # no double span
+    assert traced("x.go", table["x.go"]) is table["x.go"]
+    prev = tracing_mod.tracer.enabled
+    tracing_mod.tracer.enabled = True
+    try:
+        assert rewrapped["x.go"]({"a": 1}) == {"ok": True}
+    finally:
+        tracing_mod.tracer.enabled = prev
+    assert calls == [{"a": 1}]
+    assert tracectx.current() is None
+    assert isinstance(tracing_mod.tracer, Tracer)
+
+
+# ---------------------------------------------------------------------------
 # the real tree + the CLI contract
 # ---------------------------------------------------------------------------
 
@@ -620,7 +712,7 @@ def test_cli_lists_all_rules_and_exits_nonzero_on_findings(tmp_path):
         cwd=REPO, capture_output=True, text=True, timeout=60,
     )
     assert r.returncode == 0
-    for rule_id in ("D1", "J1", "J2", "J3", "L1", "E1", "H1", "F1", "S1"):
+    for rule_id in ("D1", "J1", "J2", "J3", "L1", "E1", "H1", "F1", "R1", "O1", "S1"):
         assert rule_id in r.stdout
     bad = tmp_path / "dmlc_tpu" / "cluster"
     bad.mkdir(parents=True)

@@ -1,0 +1,334 @@
+"""Metrics plane + flight recorder units (docs/OBSERVABILITY.md).
+
+- utils/metrics.Registry: counters + named LatencyStats + gauges behind one
+  snapshot; Prometheus text exposition (local and fleet-labeled).
+- LatencyStats.merge reservoir weighting: the statistical regression for
+  the old per-element offer bias.
+- Tracer drop accounting: past max_events drops are counted, surfaced in
+  summary(), and annotated in the Chrome export metadata.
+- cluster/flight.FlightRecorder: bounded ring, wire shape, durable dump,
+  and the component wiring (breaker open/close, gray demote, shed,
+  quarantine).
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+
+import pytest
+
+from dmlc_tpu.cluster.admission import AdmissionGate
+from dmlc_tpu.cluster.flight import FlightRecorder
+from dmlc_tpu.cluster.retrypolicy import RetryPolicy
+from dmlc_tpu.cluster.rpc import Overloaded, RpcUnreachable
+from dmlc_tpu.utils.metrics import (
+    Counters,
+    LatencyStats,
+    Registry,
+    render_prometheus,
+)
+from dmlc_tpu.utils.tracing import Tracer
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_snapshot_unifies_counters_latency_gauges(self):
+        r = Registry()
+        r.counters.inc("shed", 3)
+        r.latency("rpc/job.predict").extend([0.01, 0.02, 0.03])
+        r.gauge("queue_depth", lambda: 7)
+        snap = r.snapshot()
+        assert snap["counters"]["shed"] == 3
+        assert snap["latency"]["rpc/job.predict"]["count"] == 3.0
+        assert snap["gauges"]["queue_depth"] == 7.0
+
+    def test_shares_an_existing_counters_instance(self):
+        c = Counters()
+        r = Registry(counters=c)
+        c.inc("deadline_exceeded")
+        assert r.snapshot()["counters"]["deadline_exceeded"] == 1
+
+    def test_broken_gauge_reports_none_not_error(self):
+        r = Registry()
+        r.gauge("bad", lambda: 1 / 0)
+        assert r.snapshot()["gauges"]["bad"] is None
+
+    def test_latency_returns_same_collector(self):
+        r = Registry()
+        assert r.latency("a") is r.latency("a")
+
+    def test_prometheus_text(self):
+        r = Registry()
+        r.counters.inc("shed", 2)
+        r.counters.observe_high("queue", 9)
+        r.gauge("active", lambda: 4)
+        r.latency("rpc/sdfs.fetch").extend([0.1] * 10)
+        text = r.prometheus_text()
+        assert "# TYPE dmlc_shed counter" in text
+        assert "dmlc_shed 2" in text
+        assert "dmlc_active 4.0" in text
+        assert 'dmlc_rpc_sdfs_fetch_seconds{quantile="0.99"} 0.1' in text
+        assert "dmlc_rpc_sdfs_fetch_seconds_count 10" in text
+        # high-water marks ride the counters snapshot
+        assert "dmlc_queue_high 9" in text
+
+    def test_prometheus_node_labels(self):
+        r = Registry()
+        r.counters.inc("shed")
+        text = render_prometheus(r.snapshot(), labels='node="10.0.0.1:8852"')
+        assert 'dmlc_shed{node="10.0.0.1:8852"} 1' in text
+
+    def test_empty_registry_renders_empty(self):
+        assert Registry().prometheus_text() == ""
+
+
+# ---------------------------------------------------------------------------
+# LatencyStats.merge: weighted reservoir regression
+# ---------------------------------------------------------------------------
+
+
+class TestWeightedMerge:
+    def test_moments_still_exact(self):
+        a = LatencyStats([1.0, 2.0, 3.0])
+        b = LatencyStats([4.0, 5.0])
+        a.merge(b)
+        assert a.n == 5
+        assert a.mean == pytest.approx(3.0)
+        assert a.std == pytest.approx(statistics.stdev([1, 2, 3, 4, 5]))
+
+    def test_small_merges_keep_everything(self):
+        a = LatencyStats([1.0, 2.0])
+        a.merge(LatencyStats([3.0]))
+        assert sorted(a.reservoir) == [1.0, 2.0, 3.0]
+
+    def test_peer_with_many_offers_gets_its_true_weight(self):
+        """The regression: ``other`` saw 64x more observations than its
+        reservoir holds. A correct weighted merge yields a reservoir whose
+        composition tracks the TRUE mixture (~98.5% other); the old
+        per-element Algorithm-R offer walk converged to ~len(reservoir)
+        worth of weight instead (~66% here) — far outside the tolerance."""
+        K = LatencyStats.RESERVOIR_SIZE
+        a = LatencyStats()
+        for _ in range(2 * K):          # self: 8192 offers of 0.0
+            a.record(0.0)
+        b = LatencyStats()
+        for _ in range(128 * K):        # other: 524288 offers of 1.0
+            b.record(1.0)
+        a.merge(b)
+        assert a._offers == 130 * K
+        frac_other = sum(1 for v in a.reservoir if v == 1.0) / len(a.reservoir)
+        expected = 128 / 130  # ≈ 0.9846
+        assert frac_other == pytest.approx(expected, abs=0.01)
+        # And the percentile view agrees: the p50/p90 are the peer's value.
+        assert a.percentile(50) == 1.0
+
+    def test_merge_is_deterministic(self):
+        def build():
+            a = LatencyStats([float(i) for i in range(5000)])
+            b = LatencyStats()
+            for i in range(20000):
+                b.record(float(i) + 0.5)
+            a.merge(b)
+            return list(a.reservoir)
+
+        assert build() == build()
+
+    def test_wire_roundtrip_preserves_offer_weight(self):
+        b = LatencyStats()
+        for _ in range(100_000):
+            b.record(1.0)
+        b2 = LatencyStats.from_wire(b.to_wire())
+        a = LatencyStats([0.0] * 100)
+        a.merge(b2)
+        frac = sum(1 for v in a.reservoir if v == 1.0) / len(a.reservoir)
+        assert frac > 0.99  # 100k vs 100 offers
+
+
+# ---------------------------------------------------------------------------
+# Tracer drop accounting
+# ---------------------------------------------------------------------------
+
+
+class TestTracerDrops:
+    def test_drops_counted_and_surfaced(self, tmp_path):
+        t = Tracer(max_events=5)
+        t.enabled = True
+        for i in range(12):
+            with t.span("s"):
+                pass
+        assert t.dropped_events == 7
+        summary = t.summary()
+        assert summary["dropped_events"] == 7
+        assert summary["s"]["count"] == 12.0  # aggregates stay exact
+        path = tmp_path / "trace.json"
+        t.export(path)
+        doc = json.loads(path.read_text())
+        assert doc["otherData"]["dropped_events"] == 7
+        assert len(doc["traceEvents"]) == 5
+
+    def test_no_drops_keeps_pure_summary_shape(self):
+        t = Tracer()
+        t.enabled = True
+        with t.span("s"):
+            pass
+        assert "dropped_events" not in t.summary()
+
+    def test_reset_clears_drop_count(self):
+        t = Tracer(max_events=1)
+        t.enabled = True
+        for _ in range(3):
+            with t.span("s"):
+                pass
+        assert t.dropped_events == 2
+        t.reset()
+        assert t.dropped_events == 0
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_counts_everything(self):
+        clock = FakeClock()
+        fr = FlightRecorder(capacity=4, clock=clock, node="n1")
+        for i in range(10):
+            clock.t = float(i)
+            fr.note("shed", seq=i)
+        wire = fr.to_wire()
+        assert wire["recorded"] == 10
+        assert wire["dropped"] == 6
+        assert [e["seq"] for e in wire["events"]] == [6, 7, 8, 9]
+        assert [e["t"] for e in wire["events"]] == [6.0, 7.0, 8.0, 9.0]
+        assert wire["node"] == "n1"
+
+    def test_dump_is_valid_json_on_disk(self, tmp_path):
+        fr = FlightRecorder(capacity=8, clock=FakeClock())
+        fr.note("breaker_open", dest="m1", error="unreachable")
+        path = tmp_path / "flight.json"
+        assert fr.dump(path, reason="test")
+        doc = json.loads(path.read_text())
+        assert doc["dump_reason"] == "test"
+        assert doc["events"][0]["kind"] == "breaker_open"
+
+    def test_breaker_transitions_recorded(self):
+        clock = FakeClock()
+        fr = FlightRecorder(clock=clock)
+        policy = RetryPolicy(
+            clock=clock, breaker_threshold=2, breaker_cooldown_s=1.0,
+            flight=fr,
+        )
+        err = RpcUnreachable("down")
+        policy.record("m1", err)
+        policy.record("m1", err)   # threshold -> open
+        clock.t = 2.0              # past cooldown
+        assert policy.allow("m1")  # half-open probe
+        policy.record("m1")        # probe success -> close
+        kinds = [(e["kind"], e.get("dest")) for e in fr.events()]
+        assert ("breaker_open", "m1") in kinds
+        assert ("breaker_close", "m1") in kinds
+
+    def test_shed_recorded_by_admission_gate(self):
+        fr = FlightRecorder(clock=FakeClock())
+        gate = AdmissionGate(1, 0, name="predict", flight=fr)
+        with gate.admit():
+            with pytest.raises(Overloaded):
+                with gate.admit():
+                    pass
+        events = fr.events()
+        assert events and events[0]["kind"] == "shed"
+        assert events[0]["gate"] == "predict"
+
+    def test_quarantine_recorded_by_store(self, tmp_path):
+        from dmlc_tpu.cluster.sdfs import MemberStore
+
+        fr = FlightRecorder(clock=FakeClock())
+        store = MemberStore(tmp_path / "storage", flight=fr)
+        store.receive("f", 1, b"bytes")
+        # Rot the blob at rest, then read: quarantine + flight event.
+        path = store.blob_path("f", 1)
+        path.write_bytes(b"rotten")
+        with pytest.raises(Exception):
+            store.read("f", 1)
+        events = [e for e in fr.events() if e["kind"] == "quarantine"]
+        assert events and events[0]["name"] == "f" and events[0]["version"] == 1
+
+    def test_gray_demotion_recorded_by_scheduler(self):
+        from dmlc_tpu.cluster.rpc import SimRpcNetwork
+        from dmlc_tpu.scheduler.jobs import JobScheduler
+
+        clock = FakeClock()
+        fr = FlightRecorder(clock=clock)
+        net = SimRpcNetwork()
+        sched = JobScheduler(
+            net.client("L"), lambda: ["m1", "m2", "m3"], jobs={},
+            timer=clock, gray_factor=2.0, gray_min_latency_s=0.01,
+            flight=fr,
+        )
+        # m3 is 100x slower than the fleet; the gray check demotes it.
+        for m, lat in (("m1", 0.02), ("m2", 0.02), ("m3", 2.0)):
+            with sched._lock:
+                sched._observe_member(m, lat)
+        with sched._lock:
+            sched._gray_check()
+        assert "m3" in sched.demoted
+        kinds = [(e["kind"], e.get("member")) for e in fr.events()]
+        assert ("gray_demote", "m3") in kinds
+
+    def test_node_crash_dump_on_loop_error(self, tmp_path):
+        """A crashing maintenance loop must leave a postmortem file behind
+        (the auto-dump path), not just a log line."""
+        from dmlc_tpu.cluster.localcluster import (
+            start_local_cluster,
+            stop_local_cluster,
+            wait_until,
+        )
+
+        nodes = start_local_cluster(
+            tmp_path, 1, n_leader_candidates=1,
+            scrub_interval_s=0.05, scrub_batch=1,
+        )
+        try:
+            node = nodes[0]
+            # Sabotage the scrub loop's body: next tick raises inside _loop.
+            node.store.scrub_once = None  # type: ignore[assignment]
+            wait_until(
+                lambda: node.flight_dump_path().exists(),
+                timeout=15.0,
+                msg="flight ring dumped on loop error",
+            )
+            doc = json.loads(node.flight_dump_path().read_text())
+            assert doc["dump_reason"] == "loop_error"
+            assert any(e["kind"] == "loop_error" for e in doc["events"])
+        finally:
+            stop_local_cluster(nodes)
+        # stop() dumps again with reason=stop, overwriting — fine: the ring
+        # still contains the loop_error event.
+        doc = json.loads(nodes[0].flight_dump_path().read_text())
+        assert any(e["kind"] == "loop_error" for e in doc["events"])
+
+    def test_obs_flight_rpc_serves_the_ring(self):
+        from dmlc_tpu.cluster.observe import ObsService
+        from dmlc_tpu.cluster.rpc import SimRpcNetwork
+
+        fr = FlightRecorder(clock=FakeClock(), node="n1")
+        fr.note("gray_demote", member="m9", reason="slow")
+        net = SimRpcNetwork()
+        net.serve("n1", ObsService(Registry(), flight=fr, lane="n1").methods())
+        wire = net.client("c").call("n1", "obs.flight", {}, timeout=5.0)
+        assert wire["events"][0]["kind"] == "gray_demote"
+        assert wire["node"] == "n1"

@@ -13,7 +13,11 @@
 #                         JPEG fixtures through the PERSISTENT pool, incl.
 #                         concurrent submitters and pool shutdown/regrow
 #                         (tests/test_native_sanitize.py)
-#   7. chaos matrix     — the seeded fault-injection suites (crashes,
+#   7. trace smoke      — real localcluster run with tracing on: the
+#                         merged Perfetto JSON must load and spans from
+#                         >= 2 nodes must share one trace_id with correct
+#                         parent ordering (tools/trace_smoke.py)
+#   8. chaos matrix     — the seeded fault-injection suites (crashes,
 #                         partitions, failover, disk bit-rot/torn writes,
 #                         overload: deadlines/shedding/breakers/gray
 #                         ejection) across a 3-seed-base matrix: each leg
@@ -78,6 +82,13 @@ note "sanitizer smoke (make sanitize + corrupt-JPEG decode via the persistent po
 if env JAX_PLATFORMS=cpu python -m pytest tests/test_native_sanitize.py -q \
     -p no:cacheprovider; then
   note "sanitizer smoke OK"
+else
+  fail=1
+fi
+
+note "trace smoke (localcluster + merged fleet Perfetto trace)"
+if env JAX_PLATFORMS=cpu python tools/trace_smoke.py; then
+  note "trace smoke OK"
 else
   fail=1
 fi

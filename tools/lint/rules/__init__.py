@@ -61,6 +61,7 @@ from tools.lint.rules import (  # noqa: E402
     jit,
     locks,
     persistence,
+    rpcspan,
     rpctimeout,
     wallclock,
 )
@@ -75,4 +76,5 @@ RULES = [
     hotpath.H1,
     persistence.F1,
     rpctimeout.R1,
+    rpcspan.O1,
 ]

@@ -1,0 +1,94 @@
+"""O1 — RPC method tables must register through ``traced_methods``.
+
+The observability contract (docs/OBSERVABILITY.md) is that EVERY RPC method
+handler, on either fabric, executes under an ``rpc/<method>`` span: that
+span is where the caller's wire trace context (frame field ``t``) becomes a
+recorded parent edge, so a handler registered without it is a hole in every
+fleet trace that crosses it — the hop executes, but the merged timeline
+shows nothing and its children re-root as orphan traces.
+
+``utils/tracing.traced_methods`` wraps a whole table (idempotently), so the
+rule is purely structural: a method table handed to the fabric as a *bare
+dict* never got wrapped. Flagged inside ``dmlc_tpu/``:
+
+- ``def methods(...)`` returning a dict display / ``dict(...)`` call
+  directly (the project convention is that ``methods()`` IS the
+  registration surface — node.py merges these tables into its servers);
+- a dict display passed inline to ``<x>.serve(addr, {...})`` or
+  ``TcpRpcServer(host, port, {...}, ...)``.
+
+Tables built in variables and passed by name are out of a file-local
+rule's reach; the convention (and node.py) wraps the merged table once
+more at the server boundary, which is idempotent and catches those.
+
+A handler that genuinely must not span (none known today) uses the
+standard justified suppression: ``# dmlc-lint: disable=O1 -- why``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Finding
+
+
+def _is_bare_table(expr: ast.expr) -> bool:
+    if isinstance(expr, ast.Dict):
+        return True
+    return (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Name)
+        and expr.func.id == "dict"
+    )
+
+
+class _O1:
+    id = "O1"
+    summary = "RPC method table registered without traced_methods (span-less handlers)"
+    hint = ("wrap the table in traced_methods({...}) (utils/tracing.py) so "
+            "every handler runs under an rpc/<method> span and the wire "
+            "trace context becomes a parent edge, or justify with "
+            "'# dmlc-lint: disable=O1 -- why'")
+    scope_doc = "dmlc_tpu/"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("dmlc_tpu/")
+
+    def check(self, tree: ast.AST, relpath: str) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name != "methods":
+                    continue
+                for inner in ast.walk(node):
+                    if (
+                        isinstance(inner, ast.Return)
+                        and inner.value is not None
+                        and _is_bare_table(inner.value)
+                    ):
+                        findings.append(Finding(
+                            relpath, inner.lineno, inner.col_offset, self.id,
+                            "methods() returns a bare dict: these handlers "
+                            "run without an rpc/<method> span and break "
+                            "fleet-trace parent edges — wrap in "
+                            "traced_methods({...})",
+                        ))
+            elif isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute) and node.func.attr == "serve":
+                    inline = node.args[1:2]
+                elif isinstance(node.func, ast.Name) and node.func.id == "TcpRpcServer":
+                    inline = node.args[2:3]
+                else:
+                    continue
+                for arg in inline:
+                    if _is_bare_table(arg):
+                        findings.append(Finding(
+                            relpath, arg.lineno, arg.col_offset, self.id,
+                            "method table registered on the fabric as a bare "
+                            "dict: handlers run span-less — wrap in "
+                            "traced_methods({...})",
+                        ))
+        return findings
+
+
+O1 = _O1()
