@@ -24,6 +24,7 @@ from contextlib import contextmanager
 from typing import Iterator
 
 from dmlc_tpu.cluster.rpc import Overloaded
+from dmlc_tpu.utils.metrics import Counters
 from dmlc_tpu.utils.tracing import tracer
 
 
@@ -36,7 +37,7 @@ class AdmissionGate:
         max_inflight: int,
         max_queue: int,
         name: str = "work",
-        metrics=None,
+        metrics: Counters | None = None,
         retry_after_s: float = 0.25,
         flight=None,
     ):

@@ -144,7 +144,13 @@ def register_until_ready(
     while time.monotonic() < deadline:
         addr = addr_fn()
         try:
-            info = rpc.call(addr, "mesh.register", {"addr": self_addr})
+            # Each attempt is bounded (dmlc-analyze A3): a wedged candidate
+            # must cost one short re-poll, never the implicit 60 s default —
+            # and never more than the join window that remains.
+            attempt_s = max(0.1, min(10.0, deadline - time.monotonic()))
+            info = rpc.call(
+                addr, "mesh.register", {"addr": self_addr}, timeout=attempt_s
+            )
             if info["ready"]:
                 return info
         except RpcError as e:

@@ -376,6 +376,7 @@ class EngineBackend:
         return self._engine
 
     def __call__(self, synsets: Sequence[str]) -> list[int]:
+        # dmlc-lint: disable=A2 -- the engine lock serializes shards per engine BY DESIGN (the reference's model mutex, services.rs:493); the future wait it reaches in run_paths_stream is the decode/execute pipeline INSIDE one shard, not a foreign dependency
         with self._lock:
             engine = self._ensure_engine()
             paths = _resolve_paths(self.image_source, self.data_dir, synsets)
@@ -513,6 +514,7 @@ class ExportedBackend:
         )
 
     def warmup(self) -> None:
+        # dmlc-lint: disable=A2 -- one-time lazy init: the SDFS artifact/weights fetch MUST happen under the lock so shards arriving before the artifact is resident block instead of double-fetching (same invariant as the in-file L1 suppression inside _ensure_server)
         with self._lock:
             self._ensure_server()
 
@@ -569,6 +571,7 @@ class ExportedBackend:
 
         if not synsets:
             return []
+        # dmlc-lint: disable=A2 -- the backend lock serializes shards per artifact by design (reference's model mutex), and first-shard lazy init must block later shards on the one SDFS fetch (see _ensure_server's L1 justification)
         with self._lock:
             server = self._ensure_server()
             chunk_size = self._serve_batch
@@ -594,6 +597,7 @@ class ExportedBackend:
     def load_variables(self, variables) -> None:
         """The `train` verb's hot-swap: same validated tree the engine path
         takes, handed to the artifact executor."""
+        # dmlc-lint: disable=A2 -- hot-swap must not interleave with a running shard, so it takes the same serializing lock; the SDFS fetch it can reach is the one-time lazy init (see _ensure_server)
         with self._lock:
             self._ensure_server().variables = variables
 

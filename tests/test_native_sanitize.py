@@ -75,3 +75,131 @@ def test_sanitized_decode(harness_binaries, jpeg_inputs, which):
         f"{which} reported a problem:\n{proc.stdout[-1000:]}\n{proc.stderr[-3000:]}"
     )
     assert "failures" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Python-side thread-sanity replays (dmlc-analyze regression scenarios)
+#
+# The native harness above catches data races in C++; these replay the
+# Python findings tools/analyze surfaced (and the fixes/hierarchy that
+# resolved them) under REAL threads, so a reintroduced violation wedges
+# here — loudly, inside the CI sanitize step — instead of in production.
+# ---------------------------------------------------------------------------
+
+
+def test_lock_hierarchy_scheduler_before_retrypolicy_under_threads():
+    """Replay of the documented lock hierarchy (docs/ANALYZE.md):
+    JobScheduler._lock -> RetryPolicy._lock/Counters._lock is a ONE-WAY
+    edge. Dispatcher threads take it on every pick while other threads
+    hammer the retry policy and the status surface directly; if anyone
+    reintroduces a back-edge (retry policy or metrics calling back into
+    the scheduler under their lock), this test deadlocks and the watchdog
+    join below fails instead of hanging CI forever."""
+    import threading
+    import time
+
+    from dmlc_tpu.cluster.flight import FlightRecorder
+    from dmlc_tpu.cluster.retrypolicy import RetryPolicy
+    from dmlc_tpu.cluster.rpc import RpcUnreachable
+    from dmlc_tpu.scheduler.jobs import JobScheduler
+    from dmlc_tpu.utils.metrics import Counters
+
+    members = [f"h{i}:1" for i in range(4)]
+    flaky = members[-1]
+
+    class FakeRpc:
+        def call(self, addr, method, payload, timeout=60.0, deadline=None):
+            if addr == flaky:
+                raise RpcUnreachable(f"{addr} is down")
+            return {"predictions": [0] * len(payload["synsets"])}
+
+    metrics = Counters()
+    policy = RetryPolicy(
+        retry_rate_per_s=10_000.0, retry_burst=10_000.0, metrics=metrics,
+        flight=FlightRecorder(node="test"),
+    )
+    sched = JobScheduler(
+        FakeRpc(),
+        lambda: list(members),
+        jobs={"m": [(f"s{i}", 0) for i in range(512)]},
+        shard_size=16,
+        retry_policy=policy,
+        gray_factor=3.0,
+        metrics=metrics,
+        flight=FlightRecorder(node="test"),
+    )
+    sched.is_leading = True
+    sched._start({})
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def dispatcher():
+        try:
+            while not stop.is_set() and not sched.jobs["m"].done:
+                sched.assign_once()
+                sched.dispatch_once("m")
+        except BaseException as e:  # pragma: no cover - failure diagnostics
+            errors.append(e)
+
+    def contender():
+        try:
+            while not stop.is_set():
+                policy.allow(flaky)
+                policy.record(flaky, RpcUnreachable("down"))
+                policy.snapshot()
+                metrics.inc("noise")
+                sched.overload_status()
+        except BaseException as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=dispatcher, daemon=True) for _ in range(4)]
+    threads += [threading.Thread(target=contender, daemon=True) for _ in range(2)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline and not sched.jobs["m"].done:
+        time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert not errors, errors
+    assert sched.jobs["m"].done, (
+        "dispatch wedged: lock hierarchy violated or dispatch livelocked "
+        f"(finished={sched.jobs['m'].finished}/512)"
+    )
+    assert not any(t.is_alive() for t in threads), "threads wedged past watchdog"
+
+
+def test_mesh_register_bounded_against_wedged_leader():
+    """Replay of the fixed A3 finding (parallel/multihost.py): a wedged
+    leader candidate must cost register_until_ready one bounded attempt
+    per poll, never the implicit 60 s RPC default. Pre-fix this test takes
+    the full server-side stall; post-fix it returns within the join
+    window."""
+    import threading
+    import time
+
+    from dmlc_tpu.cluster.rpc import TcpRpc, TcpRpcServer
+    from dmlc_tpu.parallel.multihost import register_until_ready
+
+    release = threading.Event()
+
+    def wedged(p):
+        release.wait(timeout=30.0)  # a leader that never answers in time
+        return {"ready": False, "registered": 0, "num_processes": 2}
+
+    server = TcpRpcServer("127.0.0.1", 0, {"mesh.register": wedged})
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            register_until_ready(
+                TcpRpc(), server.address, "me:1", timeout_s=2.0, poll_s=0.1
+            )
+        elapsed = time.monotonic() - t0
+        assert elapsed < 10.0, (
+            f"register_until_ready hung {elapsed:.1f}s on a wedged leader — "
+            "the per-attempt timeout regressed"
+        )
+    finally:
+        release.set()
+        server.close()

@@ -1,0 +1,5 @@
+import sys
+
+from tools.analyze.core import main
+
+sys.exit(main())

@@ -1,0 +1,177 @@
+"""dmlc-analyze driver: load the project, run rules, report with witnesses.
+
+The analyzer shares dmlc-lint's suppression escape hatch — a trailing (or
+previous-line) ``# dmlc-lint: disable=A1 -- why`` comment at a finding's
+REPORTED line suppresses it, and lint rule S1 (which scans the same files)
+keeps every such comment justified. Findings carry a call-chain witness;
+where the chain spans modules the finding is anchored where the invariant
+lives (the lock acquisition, the rpc.call site), which is also where the
+fix — or the suppression — belongs.
+
+``--json`` emits the machine-readable schema shared with ``tools.lint
+--json``: a list of ``{path, line, col, rule, message, chain}`` objects,
+``chain`` a list of ``{path, line, desc}`` hops (always ``[]`` for lint).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tools.lint.core import _apply_suppressions, _collect_suppressions
+from tools.lint.core import Finding as LintFinding
+from tools.analyze.project import Project, Step
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    chain: tuple[Step, ...] = ()
+
+    def render(self, hints: dict[str, str]) -> str:
+        out = f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+        for step in self.chain:
+            out += f"\n    via {step.render()}"
+        hint = hints.get(self.rule)
+        if hint:
+            out += f"\n    fix: {hint}"
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "rule": self.rule,
+            "message": self.message,
+            "chain": [
+                {"path": s.relpath, "line": s.line, "desc": s.desc}
+                for s in self.chain
+            ],
+        }
+
+
+@dataclass
+class Analysis:
+    """What one run observed — rules contribute findings; the lock-order
+    rule also publishes the observed acquisition graph for ``--locks``."""
+
+    project: Project
+    findings: list[Finding] = field(default_factory=list)
+    lock_edges: dict[tuple[str, str], Finding] = field(default_factory=dict)
+
+
+def run_rules(package_dir: str | Path) -> Analysis:
+    from tools.analyze.rules import RULES
+
+    project = Project.load(package_dir)
+    analysis = Analysis(project)
+    for rel, line, msg in project.errors:
+        analysis.findings.append(Finding(rel, line, 0, "X0", msg))
+    for rule in RULES:
+        rule.check(analysis)
+    analysis.findings = _suppress(analysis)
+    analysis.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return analysis
+
+
+def _suppress(analysis: Analysis) -> list[Finding]:
+    """Apply ``# dmlc-lint: disable=Ax`` comments file by file, reusing the
+    lint core's tokenizer-based collection and line semantics."""
+    by_path: dict[str, list[Finding]] = {}
+    for f in analysis.findings:
+        by_path.setdefault(f.path, []).append(f)
+    src_by_path = {m.relpath: m.src for m in analysis.project.modules.values()}
+    kept: list[Finding] = []
+    for path, findings in by_path.items():
+        src = src_by_path.get(path)
+        if src is None:
+            kept.extend(findings)
+            continue
+        sups = _collect_suppressions(src)
+        # Reuse lint's application logic through its Finding shape, then map
+        # the survivors back (path+line+rule+message is unique enough here).
+        proxies = [
+            LintFinding(path, f.line, f.col, f.rule, f.message) for f in findings
+        ]
+        surviving = _apply_suppressions(proxies, sups)
+        alive = {(p.line, p.col, p.rule, p.message) for p in surviving}
+        kept.extend(
+            f for f in findings if (f.line, f.col, f.rule, f.message) in alive
+        )
+    return kept
+
+
+def _render_lock_graph(analysis: Analysis) -> str:
+    if not analysis.lock_edges:
+        return "(no lock-order edges observed)"
+    lines = ["observed held-while-acquiring edges (outer -> inner):"]
+    for (a, b), witness in sorted(analysis.lock_edges.items()):
+        lines.append(f"  {a} -> {b}   [{witness.path}:{witness.line}]")
+    return "\n".join(lines)
+
+
+def _list_rules() -> str:
+    from tools.analyze.rules import RULES
+
+    lines = []
+    for rule in RULES:
+        lines.append(f"{rule.id}  {rule.summary}")
+        lines.append(f"    fix: {rule.hint}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dmlc-analyze",
+        description="Cross-module concurrency & protocol analysis "
+                    "(docs/ANALYZE.md).",
+    )
+    parser.add_argument(
+        "package", nargs="?", default="dmlc_tpu",
+        help="package directory to analyze (default: dmlc_tpu)",
+    )
+    parser.add_argument(
+        "--json", nargs="?", const="-", default=None, metavar="FILE",
+        help="emit findings as JSON (to FILE, or stdout with no argument)",
+    )
+    parser.add_argument("--locks", action="store_true",
+                        help="print the observed lock-acquisition graph and exit")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalogue and exit")
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+    if not Path(args.package).is_dir():
+        print(f"dmlc-analyze: {args.package}: not a package directory",
+              file=sys.stderr)
+        return 2
+    analysis = run_rules(args.package)
+    if args.locks:
+        print(_render_lock_graph(analysis))
+        return 0
+    findings = analysis.findings
+    if args.json is not None:
+        doc = json.dumps([f.to_json() for f in findings], indent=2)
+        if args.json == "-":
+            print(doc)
+        else:
+            Path(args.json).write_text(doc + "\n")
+    else:
+        from tools.analyze.rules import RULES
+
+        hints = {r.id: r.hint for r in RULES}
+        for f in findings:
+            print(f.render(hints))
+    if findings:
+        print(f"dmlc-analyze: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0

@@ -1,0 +1,18 @@
+"""dmlc-analyze rule registry.
+
+A rule is a module-level object with ``id``, ``summary``, ``hint``, and
+``check(analysis) -> None`` appending ``core.Finding``s. Unlike tools/lint
+rules, these see the whole project (symbol table + call graph) and report
+call-chain witnesses.
+"""
+
+from __future__ import annotations
+
+from tools.analyze.rules import blocking, frameschema, lockorder, propagation
+
+RULES = [
+    lockorder.A1,
+    blocking.A2,
+    propagation.A3,
+    frameschema.A4,
+]

@@ -1,0 +1,74 @@
+"""A2 — blocking call reached while a lock is held, across modules.
+
+Lint rule L1 proves the invariant *inside one file*: it scans a ``with
+<lock>:`` body plus same-class ``self`` methods. This rule walks the same
+scopes through the whole-program call graph, so the blocking call can hide
+three modules away (``scheduler/worker.py`` takes the engine lock →
+``parallel/inference.py`` waits on a decode future) and still be found.
+
+Blocking classification is SHARED with L1 (``tools.lint.rules.locks
+.blocking_reason``): rpc.call, socket ops, SDFS transfers, ``time.sleep``,
+future ``result()``/``wait()``, subprocess. Condition variables are exempt
+at the lock-name level, exactly as in L1.
+
+**Precedence (one finding never fires twice):** L1 owns what it can see —
+findings whose file is in L1's scope (``dmlc_tpu/cluster/``,
+``dmlc_tpu/scheduler/``) and whose chain stays within the lock owner's
+class (direct, or only ``self.m()`` hops). Everything else — any chain
+crossing a class or module, and ANY blocking-under-lock in files L1 never
+scans — is A2's. The finding anchors at the LOCK ACQUISITION (the scope
+whose invariant is violated; suppressing there covers every blocking site
+the scope reaches), with the chain and the blocking line in the witness.
+"""
+
+from __future__ import annotations
+
+from tools.analyze.core import Analysis, Finding
+from tools.analyze.project import Step, iter_calls
+from tools.lint.rules.locks import blocking_reason
+
+
+def _l1_scope(relpath: str) -> bool:
+    return "dmlc_tpu/cluster/" in relpath or "dmlc_tpu/scheduler/" in relpath
+
+
+class _A2:
+    id = "A2"
+    summary = "blocking call reached while holding a lock (interprocedural)"
+    hint = ("copy what you need under the lock, release it, then do the "
+            "network/disk/wait work outside the critical section — or "
+            "justify with '# dmlc-lint: disable=A2 -- why' on the "
+            "acquisition line")
+
+    def check(self, analysis: Analysis) -> None:
+        project = analysis.project
+        reported: set[tuple[str, int, str, int]] = set()
+        for site in project.lock_sites():
+            for ctx, stmts, chain in project.reachable_contexts(site.func, site.body):
+                l1_covered = _l1_scope(site.func.module.relpath) and all(
+                    step.self_call for step in chain
+                )
+                if l1_covered:
+                    continue
+                for call in iter_calls(stmts):
+                    reason = blocking_reason(call, ctx.module.imports)
+                    if reason is None:
+                        continue
+                    key = (site.func.module.relpath, site.line,
+                           ctx.module.relpath, call.lineno)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    witness = chain + (Step(
+                        ctx.module.relpath, call.lineno, f"blocks: {reason}",
+                        ctx.cls is site.func.cls,
+                    ),)
+                    analysis.findings.append(Finding(
+                        site.func.module.relpath, site.line, 0, self.id,
+                        f"{reason} reached while holding {site.lock_id} "
+                        f"({site.display}, acquired here)",
+                        witness,
+                    ))
+
+
+A2 = _A2()

@@ -1,0 +1,129 @@
+"""A1 — lock-order deadlock detection across the whole package.
+
+Every lock acquisition site opens a scope; every further lock acquired
+inside that scope — directly, or anywhere down the resolvable call graph —
+adds a *held-while-acquiring* edge ``outer -> inner`` to the project's lock
+graph. A cycle in that graph is a deadlock candidate: two threads entering
+the cycle from different edges block each other forever, and the hang only
+manifests under exactly the wrong interleaving, which is why this must be
+caught statically.
+
+Lock identity is class-qualified (``pkg.mod.Cls._lock``): all instances of
+a class share one identity, which is the right granularity for an ORDER
+hierarchy (the rule "scheduler before retry-policy" is about classes, not
+objects). Two deliberate consequences:
+
+- Reacquiring the same identity through ``self`` calls is reported only
+  for non-reentrant ``threading.Lock``s (an RLock self-nest is legal and
+  common); for the non-reentrant case it is a guaranteed single-thread
+  deadlock, the strongest finding this rule makes.
+- Sibling *instances* of one class locking each other (rare; none in this
+  codebase) collapse onto a self-edge and are reported under the same
+  non-reentrant check.
+
+Findings anchor at the OUTER acquisition of the first edge in the cycle —
+the place whose ordering decision the fix (or the justified suppression)
+has to defend. Every edge of the cycle prints its full call-chain witness.
+"""
+
+from __future__ import annotations
+
+from tools.analyze.core import Analysis, Finding
+from tools.analyze.project import LockSite, Step, iter_withs
+from tools.lint.rules.locks import _lock_name
+
+
+class _A1:
+    id = "A1"
+    summary = "lock-order deadlock: cyclic held-while-acquiring edges"
+    hint = ("establish one global acquisition order (docs/ANALYZE.md 'Lock "
+            "hierarchy') and release the outer lock before taking the inner "
+            "one against the order")
+
+    def check(self, analysis: Analysis) -> None:
+        project = analysis.project
+        edges: dict[tuple[str, str], tuple[LockSite, tuple[Step, ...], str, int]] = {}
+        for site in project.lock_sites():
+            for ctx, stmts, chain in project.reachable_contexts(site.func, site.body):
+                for node in iter_withs(stmts):
+                    for item in node.items:
+                        display = _lock_name(item.context_expr)
+                        if display is None:
+                            continue
+                        inner_id, _ = project._lock_identity(display, ctx)
+                        if inner_id == site.lock_id:
+                            self._reacquire(analysis, site, chain, ctx, node.lineno)
+                            continue
+                        key = (site.lock_id, inner_id)
+                        if key not in edges:
+                            edges[key] = (site, chain, ctx.module.relpath, node.lineno)
+        for (outer, inner), (site, chain, rel, line) in edges.items():
+            inner_step = Step(rel, line, f"acquires {inner}", False)
+            analysis.lock_edges[(outer, inner)] = Finding(
+                site.func.module.relpath, site.line, 0, self.id,
+                f"{outer} held while acquiring {inner}",
+                chain + (inner_step,),
+            )
+        self._report_cycles(analysis, edges)
+
+    def _reacquire(self, analysis: Analysis, site: LockSite, chain, ctx, line: int) -> None:
+        """Same lock identity acquired again while held. Only meaningful for
+        non-reentrant locks reached via ``self`` calls (same instance by
+        construction); RLocks nest legally."""
+        if site.reentrant:
+            return
+        if chain and not all(step.self_call for step in chain):
+            return  # possibly a different instance of the class: no verdict
+        analysis.findings.append(Finding(
+            site.func.module.relpath, site.line, 0, self.id,
+            f"non-reentrant {site.lock_id} ({site.display}) reacquired while "
+            f"already held — single-thread self-deadlock",
+            chain + (Step(ctx.module.relpath, line,
+                          f"reacquires {site.lock_id}", True),),
+        ))
+
+    def _report_cycles(self, analysis: Analysis, edges: dict) -> None:
+        graph: dict[str, set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+        for cycle in _simple_cycles(graph):
+            pairs = list(zip(cycle, cycle[1:] + cycle[:1]))
+            site, chain, rel, line = edges[pairs[0]]
+            witness: tuple[Step, ...] = ()
+            for a, b in pairs:
+                s, c, r, ln = edges[(a, b)]
+                witness += (Step(s.func.module.relpath, s.line,
+                                 f"holds {a}  [{s.func.qname}]", False),)
+                witness += c
+                witness += (Step(r, ln, f"acquires {b}", False),)
+            analysis.findings.append(Finding(
+                site.func.module.relpath, site.line, 0, self.id,
+                "lock-order deadlock candidate: "
+                + " -> ".join(cycle + [cycle[0]]),
+                witness,
+            ))
+
+
+def _simple_cycles(graph: dict[str, set[str]]) -> list[list[str]]:
+    """Every elementary cycle, each reported once (rotated to start at its
+    smallest node). Lock graphs are tiny; a DFS enumeration is fine."""
+    cycles: set[tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: list[str], on_path: set[str]):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                i = path.index(min(path))
+                cycles.add(tuple(path[i:] + path[:i]))
+            elif nxt not in on_path and nxt >= start:
+                # `>= start` canonicalizes: each cycle is enumerated only
+                # from its smallest node, avoiding duplicates.
+                on_path.add(nxt)
+                dfs(start, nxt, path + [nxt], on_path)
+                on_path.discard(nxt)
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return [list(c) for c in sorted(cycles)]
+
+
+A1 = _A1()

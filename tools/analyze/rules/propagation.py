@@ -1,0 +1,119 @@
+"""A3 — deadline/trace propagation across the request tree.
+
+The overload/observability contract (docs/OVERLOAD.md, OBSERVABILITY.md):
+every hop of a request states its budget, and the ambient ``Deadline`` /
+``TraceContext`` bound by ``serve_with_deadline`` must survive to nested
+calls. Lint rule R1 enforces the timeout half file-locally — but only
+inside ``dmlc_tpu/cluster/`` and ``dmlc_tpu/scheduler/``. This rule closes
+the two cross-module holes:
+
+- **Unbounded calls outside R1's scope.** Any ``<...>.rpc.call(...)``
+  anywhere else in the package (``parallel/``, ``models/``, ``cli.py``,
+  ...) without ``timeout=``/``deadline=`` waits the implicit 60 s on a
+  dead peer — and when such a site is reachable from an RPC *handler*
+  (registered via ``methods()``/``traced_methods``), the witness chain
+  shows which serving path inherits the hang. Precedence: sites in R1's
+  scope are R1's alone; A3 never re-reports them.
+- **Silently clearing the ambient context.** ``deadline.bind(None)`` /
+  ``tracectx.bind(None)`` with a literal None anywhere outside the fabric
+  itself (``cluster/rpc.py``, which legitimately binds the wire value,
+  and the two defining modules) detaches every nested call from the
+  caller's budget/trace — the request tree forgets who it belongs to.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analyze.core import Analysis, Finding
+from tools.analyze.project import FuncDef, Step, iter_calls
+from tools.lint.rules import dotted_name
+
+_R1_SCOPE = ("dmlc_tpu/cluster/", "dmlc_tpu/scheduler/")
+#: modules that own the binding machinery (suffix-matched on dotted name)
+_BIND_OWNERS = (".cluster.rpc", ".cluster.deadline", ".cluster.tracectx")
+
+
+def _is_rpc_call(call: ast.Call) -> bool:
+    func = call.func
+    if not (isinstance(func, ast.Attribute) and func.attr == "call"):
+        return False
+    receiver = dotted_name(func.value)
+    return receiver is not None and receiver.split(".")[-1] == "rpc"
+
+
+def _is_bounded(call: ast.Call) -> bool:
+    if any(kw.arg in ("timeout", "deadline") for kw in call.keywords):
+        return True
+    return len(call.args) >= 4  # positional timeout
+
+
+class _A3:
+    id = "A3"
+    summary = "deadline/trace propagation hole on a cross-module call path"
+    hint = ("pass timeout= or deadline= at the rpc.call site (the ambient "
+            "deadline then caps it automatically); never bind(None) over an "
+            "inherited Deadline/TraceContext outside cluster/rpc.py")
+
+    def check(self, analysis: Analysis) -> None:
+        project = analysis.project
+        # Bare rpc.call sites outside R1's file scope, keyed for chain lookup.
+        bare: dict[tuple[str, int], tuple[FuncDef, ast.Call]] = {}
+        for mod in project.modules.values():
+            in_r1 = any(s in mod.relpath for s in _R1_SCOPE)
+            for fd in project._all_funcs(mod):
+                for call in iter_calls(fd.node.body):
+                    if not in_r1 and _is_rpc_call(call) and not _is_bounded(call):
+                        bare[(mod.relpath, call.lineno)] = (fd, call)
+                    self._check_bind_none(analysis, mod, call)
+        if not bare:
+            return
+        # Attach handler->site chains where a serving path reaches the site.
+        chains: dict[tuple[str, int], tuple[str, tuple[Step, ...]]] = {}
+        for method_name, handler, hrel, hline in project.rpc_handlers():
+            for ctx, stmts, chain in project.reachable_contexts(
+                handler, handler.node.body
+            ):
+                for call in iter_calls(stmts):
+                    key = (ctx.module.relpath, call.lineno)
+                    if key in bare and key not in chains:
+                        entry = Step(hrel, hline,
+                                     f"handler {method_name!r}  [{handler.qname}]",
+                                     False)
+                        chains[key] = (method_name, (entry,) + chain)
+        for (rel, line), (fd, call) in sorted(bare.items()):
+            via = chains.get((rel, line))
+            suffix = ""
+            chain: tuple[Step, ...] = ()
+            if via is not None:
+                suffix = (f" — reachable from RPC handler {via[0]!r}, whose "
+                          f"inherited budget this hop silently ignores")
+                chain = via[1]
+            analysis.findings.append(Finding(
+                rel, line, call.col_offset, self.id,
+                "rpc.call without timeout=/deadline= outside R1's scope: "
+                "this hop waits the implicit 60 s default" + suffix,
+                chain,
+            ))
+
+    def _check_bind_none(self, analysis: Analysis, mod, call: ast.Call) -> None:
+        if mod.name.endswith(_BIND_OWNERS):
+            return
+        dotted = mod.imports.resolve(dotted_name(call.func))
+        if dotted is None:
+            return
+        if not (dotted.endswith((".deadline.bind", ".tracectx.bind"))
+                or dotted in ("deadline.bind", "tracectx.bind")):
+            return
+        if not (call.args and isinstance(call.args[0], ast.Constant)
+                and call.args[0].value is None):
+            return
+        what = "Deadline" if "deadline" in dotted else "TraceContext"
+        analysis.findings.append(Finding(
+            mod.relpath, call.lineno, call.col_offset, self.id,
+            f"bind(None) clears the ambient {what} for every nested call — "
+            f"only the RPC fabric (cluster/rpc.py) may rebind from the wire",
+        ))
+
+
+A3 = _A3()

@@ -1,7 +1,10 @@
 #!/usr/bin/env bash
-# The one correctness-tooling gate (docs/LINT.md):
+# The one correctness-tooling gate (docs/LINT.md, docs/ANALYZE.md):
 #
-#   1. dmlc-lint        — project-invariant static analysis (tools/lint)
+#   1. static analysis  — dmlc-lint (file-local invariants, tools/lint)
+#                         + dmlc-analyze (whole-program concurrency &
+#                         protocol rules A1-A4, tools/analyze), rendered
+#                         as ONE summarized step
 #   2. ruff             — generic Python lint (ruff.toml)
 #   3. mypy --strict    — types, strict on dmlc_tpu/cluster/ only
 #                         (incremental adoption: other packages are not
@@ -35,10 +38,14 @@ cd "$(dirname "$0")/.."
 fail=0
 note() { printf '== %s\n' "$*"; }
 
-note "dmlc-lint"
-if python -m tools.lint dmlc_tpu/ tools/ tests/; then
-  note "dmlc-lint OK"
+note "static analysis (dmlc-lint + dmlc-analyze)"
+sa_fail=0
+python -m tools.lint dmlc_tpu/ tools/ tests/ || sa_fail=1
+python -m tools.analyze dmlc_tpu || sa_fail=1
+if [ "$sa_fail" -eq 0 ]; then
+  note "static analysis OK (dmlc-lint clean, dmlc-analyze clean)"
 else
+  note "static analysis FAILED (findings above; docs/LINT.md + docs/ANALYZE.md)"
   fail=1
 fi
 

@@ -167,14 +167,36 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS))
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalogue and exit")
+    parser.add_argument(
+        "--json", nargs="?", const="-", default=None, metavar="FILE",
+        help="emit findings as JSON (to FILE, or stdout with no argument) — "
+             "the same {path,line,col,rule,message,chain} schema "
+             "dmlc-analyze emits (chain is always [] here)",
+    )
     args = parser.parse_args(argv)
     if args.list_rules:
         print(_list_rules())
         return 0
     findings = run(args.paths)
-    hints = {r.id: r.hint for r in RULES}
-    for f in findings:
-        print(f.render(hints))
+    if args.json is not None:
+        import json
+
+        doc = json.dumps(
+            [
+                {"path": f.path, "line": f.line, "col": f.col,
+                 "rule": f.rule, "message": f.message, "chain": []}
+                for f in findings
+            ],
+            indent=2,
+        )
+        if args.json == "-":
+            print(doc)
+        else:
+            Path(args.json).write_text(doc + "\n")
+    else:
+        hints = {r.id: r.hint for r in RULES}
+        for f in findings:
+            print(f.render(hints))
     if findings:
         print(f"dmlc-lint: {len(findings)} finding(s)", file=sys.stderr)
         return 1

@@ -15,6 +15,16 @@ Closures/defs created under the lock are NOT scanned: they typically run
 after release (thread pools, callbacks). Condition variables
 (names containing "cond"/"cv") are exempt — ``cv.wait()`` *releases*
 the lock by contract.
+
+Scope split with dmlc-analyze rule A2 (docs/ANALYZE.md): L1 deliberately
+stays same-class and file-local — that keeps it fast enough for every
+commit, and the finding lands exactly where the blocking line is. Chains
+that leave the class or the file (``self.other_component.fetch()`` three
+modules deep) are A2's: the whole-program analyzer walks the same lock
+scopes through the project call graph and skips everything L1 already
+covers, so one finding never fires from both tools. The blocking-call
+classification below (``blocking_reason``) is the single shared
+definition both rules use.
 """
 
 from __future__ import annotations
@@ -60,28 +70,35 @@ def _receiver_name(func: ast.expr) -> str:
     return ""
 
 
-def _blocking_reason(call: ast.Call, imports: ImportMap) -> str | None:
-    """Why this call blocks, or None if it does not (statically)."""
+def blocking_reason(call: ast.Call, imports: ImportMap) -> str | None:
+    """Why this call blocks, or None if it does not (statically). Shared
+    with dmlc-analyze rule A2 — the ONE definition of "blocking" for both
+    the per-file and the whole-program lock analyses."""
     func = call.func
     if isinstance(func, ast.Attribute):
         attr = func.attr
         recv = _receiver_name(func)
+        spelled = dotted_name(func) or f"...{attr}"
         if attr in _BLOCKING_METHODS:
             return f"socket operation .{attr}()"
         if attr == "call" and "rpc" in recv:
-            return f"RPC {dotted_name(func)}() (network round-trip)"
+            return f"RPC {spelled}() (network round-trip)"
         if attr in _SDFS_METHODS and "sdfs" in recv:
-            return f"SDFS transfer {dotted_name(func)}()"
+            return f"SDFS transfer {spelled}()"
         if attr == "result":
-            return f"future wait {dotted_name(func)}()"
+            return f"future wait {spelled}()"
         if attr == "wait" and "cond" not in recv and "cv" not in recv:
-            return f"blocking wait {dotted_name(func)}()"
+            return f"blocking wait {spelled}()"
     name = imports.resolve_node(func)
     if name in _BLOCKING_FUNCS:
         return f"{name}() {_BLOCKING_FUNCS[name]}"
     if name and name.startswith(_BLOCKING_PREFIXES):
         return f"subprocess call {name}()"
     return None
+
+
+#: Backwards-compatible private alias (pre-A2 name).
+_blocking_reason = blocking_reason
 
 
 class _L1:
