@@ -225,6 +225,17 @@ def annotate_train_entries(train: dict, old_train: dict) -> dict:
     )
 
 
+def annotate_lm_decode_entries(section: dict, old_section: dict) -> dict:
+    """lm_decode guard, same contract as flash/train: decoded tok/s track
+    their best-known MAXIMUM, a >2x-low window is flagged (and merge keeps
+    the previous healthy entry); a slot/page-geometry change resets the
+    history so a deliberate reconfiguration is judged fresh."""
+    return _annotate_rate_entries(
+        section, old_section, ("tokens_per_sec",), max, 1,
+        config_keys=("slots", "requests", "page_size", "prompt", "max_new"),
+    )
+
+
 def update_history_best(history_best: dict, results: list[dict]) -> dict:
     """Fold this run's configs into the per-(model,batch) best-known record.
     Degraded-window measurements never improve the record, so a later healthy
@@ -409,11 +420,11 @@ def merge_detail(new: dict, old: dict) -> dict:
     else:
         out["e2e"] = new_e2e
 
-    # flash/train: dict-of-entry sections — merge per entry so a truncated
-    # run (e.g. train that only reached vit_b16_train) keeps the previous
-    # lm_flash_train instead of deleting it; staleness is stamped INSIDE
-    # each kept entry, never at section level where consumers iterate.
-    for key in ("flash", "train"):
+    # flash/train/lm_decode: dict-of-entry sections — merge per entry so a
+    # truncated run (e.g. train that only reached vit_b16_train) keeps the
+    # previous lm_flash_train instead of deleting it; staleness is stamped
+    # INSIDE each kept entry, never at section level where consumers iterate.
+    for key in ("flash", "train", "lm_decode"):
         new_sec = {k: v for k, v in (new.get(key) or {}).items() if isinstance(v, dict)}
         old_sec = {k: v for k, v in (old.get(key) or {}).items() if isinstance(v, dict)}
         merged = {k: dict(v, stale=True) for k, v in old_sec.items()}
@@ -894,6 +905,146 @@ def bench_train(deadline: float | None = None) -> dict:
     return out
 
 
+def bench_lm_decode(
+    deadline: float | None = None,
+    *,
+    model: str | None = None,
+    slots: int = 8,
+    n_req: int = 16,
+    prompt_len: int = 128,
+    max_new: int = 128,
+    page_size: int = 64,
+    entry_name: str = "continuous8",
+) -> dict:
+    """Continuous-batching decode throughput (dmlc_tpu/generate/): N
+    concurrent requests sharing one fixed-shape decode batch over the paged
+    KV cache. Records tok/s, per-token latency p50/p99, mean slot occupancy
+    (resident slots per step / max_slots), and the ``gen/step`` span
+    aggregates — the serving-side twin of the lm_flash_train leg.
+
+    The model is the bench LM geometry (8 layers, hidden 768, head_dim 128
+    — the MXU lane width, see ROOFLINE_NOTES["lm_flash_train"]) served
+    through the real SlotScheduler: prefill on join, ragged paged
+    attention per step, tokens streamed per step with a host sync each —
+    so the number includes the honest per-token dispatch cost, not just
+    device occupancy.
+    """
+    import threading
+
+    import jax
+
+    from dmlc_tpu.generate.slots import SlotScheduler
+    from dmlc_tpu.models.registry import ModelSpec, get_model, register
+    from dmlc_tpu.utils.metrics import LatencyStats
+    from dmlc_tpu.utils.tracing import tracer
+
+    def time_left() -> float:
+        return _time_left(deadline)
+
+    # The decode-bench LM: lm_flash_train's geometry, registered once under
+    # its own name so the engine can build it like any servable model.
+    # ``model`` overrides it (tests smoke this leg with lm_small on CPU).
+    name = model or "lm_bench_decode"
+    try:
+        get_model(name)
+    except KeyError:
+        import jax.numpy as jnp
+
+        from dmlc_tpu.parallel.sp_transformer import SPTransformerLM
+
+        def build(dtype=jnp.bfloat16):
+            return SPTransformerLM(
+                vocab=32768, num_layers=8, num_heads=6, hidden=768,
+                mlp_dim=3072, max_len=1024, schedule="flash", dtype=dtype,
+            )
+
+        register(ModelSpec(name, build, 1024, 32768, classifier=False, kind="lm"))
+
+    from dmlc_tpu.generate.engine import GenerationEngine
+
+    vocab = get_model(name).num_outputs
+    # Pool sized for the WHOLE workload (every request's submit-time
+    # reservation + full decode growth), so the measured leg is pure
+    # continuous-batching throughput with zero sheds/evictions — overload
+    # behavior is pinned by tests, not benched here.
+    pages_per_req = -(-(prompt_len + max_new + 1) // page_size)
+    engine = GenerationEngine(
+        name, max_slots=slots, page_size=page_size,
+        num_pages=n_req * pages_per_req + slots + 1,
+        max_prefill=prompt_len,
+    )
+    sched = SlotScheduler(engine, max_waiting=n_req)
+    occupancy: list[int] = []
+    token_times = LatencyStats()
+    was_enabled = tracer.enabled
+    tracer.reset()
+    tracer.enabled = True
+    try:
+        # Warm both compiled programs outside the timed window.
+        sched.submit([1] * prompt_len, max_new_tokens=2).result(timeout=600)
+        rng = np.random.default_rng(0)
+        prompts = [
+            rng.integers(0, vocab, size=prompt_len).tolist() for _ in range(n_req)
+        ]
+
+        done = threading.Event()
+
+        def sample_occupancy() -> None:
+            while not done.is_set():
+                occupancy.append(engine.slots_active)
+                time.sleep(0.05)
+
+        sampler = threading.Thread(target=sample_occupancy, daemon=True)
+        sampler.start()
+        t0 = time.perf_counter()
+        streams = [sched.submit(p, max_new_tokens=max_new) for p in prompts]
+        for s in streams:
+            if time_left() <= 0:
+                break
+            s.wait(timeout=min(600.0, max(1.0, time_left())))
+        dt = time.perf_counter() - t0
+        done.set()
+        tokens = sum(len(s.tokens()) for s in streams)
+        # Per-token latency from the scheduler's step stats: one step
+        # produces one token per resident slot, so the step time IS the
+        # per-token latency at the serving boundary.
+        token_times = sched.step_stats
+    finally:
+        done.set()
+        tracer.enabled = was_enabled
+        sched.stop()
+    spans = {
+        n: {
+            "count": int(s["count"]),
+            "mean_ms": round(s["mean"] * 1e3, 3),
+            "p99_ms": round(s["p99"] * 1e3, 3),
+        }
+        for n, s in tracer.summary().items()
+        if isinstance(s, dict) and s.get("count")
+    }
+    tracer.reset()
+    n_chips = jax.device_count()
+    entry = {
+        "slots": slots,
+        "requests": n_req,
+        "prompt": prompt_len,
+        "max_new": max_new,
+        "page_size": page_size,
+        "chips": n_chips,
+        "tokens": tokens,
+        "tokens_per_sec": round(tokens / dt, 1) if dt > 0 else None,
+        "token_p50_ms": round(token_times.percentile(50) * 1e3, 2)
+        if len(token_times) else None,
+        "token_p99_ms": round(token_times.percentile(99) * 1e3, 2)
+        if len(token_times) else None,
+        "slot_occupancy": round(float(np.mean(occupancy)) / slots, 3)
+        if occupancy else None,
+        "sheds": sched.sheds,
+        "span_aggregates": spans,
+    }
+    return {entry_name: entry}
+
+
 RAW_SIZE = 256  # corpus native size; the device-resize staging size
 
 # Measured bounds behind the MFU numbers (VERDICT r4 item: ViT-class models
@@ -1141,6 +1292,7 @@ def main() -> None:
         "flash": 110.0,  # incl. the sp=2 CPU-subprocess memory analysis
         "curve_point": 30.0,
         "train": 100.0,
+        "lm_decode": 90.0,
     }
 
     # Per-model batch tuning, backed by the measured batch curves that land
@@ -1403,6 +1555,27 @@ def main() -> None:
         except Exception as e:
             print(f"[bench-train] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
 
+    # Continuous-batching decode serving (dmlc_tpu/generate/): the LLM
+    # serving twin of the train leg, budget-gated like every extra.
+    lm_decode = {}
+    if not over_budget("lm_decode"):
+        try:
+            lm_decode = annotate_lm_decode_entries(
+                bench_lm_decode(deadline=time.monotonic() + CAPS["lm_decode"]),
+                prev_detail.get("lm_decode") or {},
+            )
+            for key, r in lm_decode.items():
+                print(
+                    f"[bench-lm-decode] {key}: {r.get('tokens_per_sec')} tok/s "
+                    f"({r.get('requests')} reqs over {r.get('slots')} slots, "
+                    f"occupancy {r.get('slot_occupancy')}) "
+                    f"token p50={r.get('token_p50_ms')}ms "
+                    f"p99={r.get('token_p99_ms')}ms",
+                    file=sys.stderr,
+                )
+        except Exception as e:
+            print(f"[bench-lm-decode] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+
     # Extra models: measured numbers for the remaining reference configs,
     # strictly after every primary section has had its shot at the budget.
     for model in [m.strip() for m in args.extra_models.split(",") if m.strip()]:
@@ -1441,6 +1614,7 @@ def main() -> None:
         "batch_curve": curve,
         "flash": flash,
         "train": train,
+        "lm_decode": lm_decode,
         "roofline_notes": ROOFLINE_NOTES,
     }
     if degraded:

@@ -60,6 +60,10 @@ Commands (reference: README.md:10-23):
                                         sha256 sidecars (rot -> quarantine + heal)
   train | t                             broadcast model weights to members
   predict                               start/resume the inference jobs
+  generate <model> <tok> [<tok> ...]    stream an LM generation (token ids;
+                                        flags: --max-new N --temp T); served
+                                        by the continuous-batching worker
+                                        (docs/GENERATE.md)
   export <model>                        publish the model's StableHLO executable
   export-bundle <model> <dir>           write the native PJRT host bundle
                                         (program.mlir + weights + manifests;
@@ -183,6 +187,25 @@ class Cli:
         if cmd == "predict":
             reply = n.predict()
             return f"started jobs: {', '.join(reply['jobs'])}"
+        if cmd == "generate":
+            max_new, temp, rest = 32, 0.0, []
+            it = iter(args)
+            for a in it:
+                if a == "--max-new":
+                    max_new = int(next(it, "32"))
+                elif a == "--temp":
+                    temp = float(next(it, "0"))
+                else:
+                    rest.append(a)
+            if len(rest) < 2:
+                return "usage: generate <model> <tok> [<tok> ...] [--max-new N] [--temp T]"
+            model, prompt = rest[0], [int(t) for t in rest[1:]]
+            reply = n.generate(model, prompt, max_new_tokens=max_new, temperature=temp)
+            toks = reply["tokens"]
+            return (
+                f"{model} @ {reply['member']}: {len(toks)} token(s)\n"
+                "  " + " ".join(str(t) for t in toks)
+            )
         if cmd == "export":
             if len(args) != 1:
                 return "usage: export <model_name>"

@@ -178,11 +178,42 @@ class ClusterNode:
                     for name in config.job_models
                 }
         self.worker = PredictWorker(backends, gate=self.predict_gate)
-        self.model_loader = ModelLoader(self.store, self.worker.backends)
+        # --- generation serving (dmlc_tpu/generate/, docs/GENERATE.md) --
+        # Continuous-batching LM worker: slots join/leave the running
+        # decode batch between steps, KV lives in fixed-size pages, and
+        # tokens stream back through the chunk-poll protocol. Built only
+        # when configured — image-only nodes pay nothing.
+        self.generate_worker = None
+        self._gen_backends: dict = {}
+        if config.generate_models:
+            from dmlc_tpu.generate.worker import GenerateWorker, GenerationBackend
+
+            self._gen_backends = {
+                name: GenerationBackend(
+                    name,
+                    max_slots=config.gen_max_slots,
+                    page_size=config.gen_page_size,
+                    num_pages=config.gen_num_pages,
+                    max_prefill=config.gen_max_prefill,
+                    max_waiting=config.gen_max_waiting,
+                    metrics=self.metrics,
+                    flight=self.flight,
+                    registry=self.registry,
+                    lane=lambda: self.lane,
+                )
+                for name in config.generate_models
+            }
+            self.generate_worker = GenerateWorker(
+                self._gen_backends, session_ttl_s=config.gen_session_ttl_s
+            )
+        self.model_loader = ModelLoader(
+            self.store, self.worker.backends, extra=self._gen_backends
+        )
         self.obs = ObsService(self.registry, flight=self.flight, lane=self.lane)
         methods = traced_methods({
             **self.sdfs_member.methods(),
             **self.worker.methods(),
+            **(self.generate_worker.methods() if self.generate_worker else {}),
             **self.model_loader.methods(),
             **self.obs.methods(),
             "node.info": self._node_info,
@@ -415,7 +446,10 @@ class ClusterNode:
             from dmlc_tpu import native
 
             native.ensure_built()  # compile off the hot path, before serving
-            for backend in self.worker.backends.values():
+            for backend in [
+                *self.worker.backends.values(),
+                *self._gen_backends.values(),
+            ]:
                 if not hasattr(backend, "warmup"):
                     continue
                 try:
@@ -461,6 +495,8 @@ class ClusterNode:
         self._stop.set()
         for b in self._batchers:
             b.stop(timeout_s=2.0)
+        for gb in self._gen_backends.values():
+            gb.stop(timeout_s=2.0)
         for t in self._threads:
             t.join(timeout=2.0)
         self.member_server.close()
@@ -715,6 +751,39 @@ class ClusterNode:
             self.tracker.current, "job.start", {}, timeout=self.config.rpc_deadline_s
         )
 
+    def generate(
+        self,
+        model: str,
+        prompt: list[int],
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+    ) -> dict:
+        """CLI verb: stream one generation to completion. Served locally
+        when this node hosts the model's generation backend, else from the
+        first active member that does (docs/GENERATE.md)."""
+        from dmlc_tpu.cluster.rpc import RpcError
+        from dmlc_tpu.generate import worker as gen_worker
+
+        addrs = [self.self_member_addr] if model in self._gen_backends else []
+        addrs += [a for a in self.active_member_addrs() if a not in addrs]
+        last: Exception | None = None
+        for addr in addrs:
+            try:
+                tokens = gen_worker.generate(
+                    self.rpc, addr, model, prompt,
+                    max_new_tokens=max_new_tokens, temperature=temperature,
+                    poll_timeout=self.config.rpc_deadline_s,
+                )
+                return {"member": addr, "tokens": tokens}
+            except RpcError as e:
+                last = e
+                if "not served here" in str(e):
+                    continue  # try a member that hosts the model
+                raise
+        raise last if last is not None else RpcError(
+            f"no active member serves generation for {model!r}"
+        )
+
     def jobs_report(self) -> dict:
         return self.rpc.call(
             self.tracker.current, "job.report", {}, timeout=self.config.rpc_deadline_s
@@ -750,6 +819,8 @@ class ClusterNode:
                 for name, b in self.worker.backends.items()
                 if isinstance(b, DynamicBatcher)
             }
+        if self.generate_worker is not None:
+            out["generate"] = self.generate_worker.summary()
         if remote:
             try:
                 reply = self.rpc.call(

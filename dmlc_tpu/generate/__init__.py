@@ -1,0 +1,13 @@
+"""Generation subsystem: continuous batching + paged KV cache LLM serving.
+
+- ``kvcache``  — page pool, free-list allocator, per-slot page tables
+- ``engine``   — jitted fixed-shape decode/prefill over the paged cache
+- ``slots``    — step-level slot scheduler (join/leave between steps)
+- ``worker``   — ``job.generate`` RPC surface + chunk-poll token streaming
+
+See docs/GENERATE.md for the slot lifecycle, page layout, and wire format.
+"""
+
+from dmlc_tpu.generate.kvcache import PageAllocator, PagedKVCache, PagePoolExhausted
+
+__all__ = ["PageAllocator", "PagedKVCache", "PagePoolExhausted"]

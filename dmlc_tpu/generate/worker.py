@@ -1,0 +1,276 @@
+"""Member-side generation worker: the ``job.generate`` RPC surface.
+
+Mirrors ``scheduler/worker.PredictWorker``'s shape — a backend per model,
+an RPC method table wired into the member server — but the verb is
+autoregressive, so one request produces MANY replies' worth of tokens. The
+control-plane fabric is strict request/response (cluster/rpc.py), so
+streaming rides a chunk-poll protocol (wire format: docs/GENERATE.md):
+
+- ``job.generate``  {model, prompt:[int], max_new_tokens, temperature?,
+  eos_id?} -> {gen_id}. Admission happens HERE (slot table + page pool,
+  typed ``Overloaded`` on refusal) and the ambient deadline/trace context
+  captured by the slot scheduler ride the whole generation.
+- ``job.generate_poll``  {gen_id, ack:int} -> {chunks: [[seq, [tok,..]],
+  ...], done, error?}. Chunks are seq-numbered and retained until covered
+  by the CUMULATIVE ack, so a retried poll (lost reply, client crash +
+  resume) re-reads identical chunks and the client dedups by seq —
+  exactly-once token delivery over an at-least-once fabric.
+- ``job.generate_cancel`` {gen_id} -> {cancelled} releases the consumer's
+  interest; the session is dropped at the next sweep.
+
+Sessions for which no poll arrives within ``session_ttl_s`` are swept (an
+abandoned client must not pin chunks forever); ``generate_stream`` /
+``generate`` are the client helpers the CLI and tests drive.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Iterator
+
+from dmlc_tpu.cluster.rpc import RpcError
+from dmlc_tpu.utils.tracing import traced_methods, tracer
+
+log = logging.getLogger(__name__)
+
+
+class GenerationBackend:
+    """One servable LM: engine + slot scheduler, built lazily like
+    EngineBackend (JAX import + compile are heavy; nodes that never see a
+    generate request shouldn't pay)."""
+
+    def __init__(
+        self,
+        model_name: str,
+        *,
+        max_slots: int = 8,
+        page_size: int = 16,
+        num_pages: int = 128,
+        max_prefill: int = 64,
+        max_waiting: int = 0,
+        use_pallas: bool | None = None,
+        metrics=None,
+        flight=None,
+        registry=None,
+        lane=None,
+    ):
+        self.model_name = model_name
+        self.max_slots = int(max_slots)
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.max_prefill = int(max_prefill)
+        self.max_waiting = int(max_waiting)
+        self.use_pallas = use_pallas
+        self.metrics = metrics
+        self.flight = flight
+        self.registry = registry
+        self.lane = lane
+        self._scheduler = None
+        self._lock = threading.Lock()
+
+    def warmup(self) -> None:
+        """Build + compile now (node startup, before membership — same
+        GIL-starvation rationale as EngineBackend.warmup)."""
+        self._ensure()
+
+    def _ensure(self):
+        # dmlc-lint: disable=A2 -- one-time lazy init: requests arriving before the engine exists must block on the single build, not double-build it (EngineBackend's pattern)
+        with self._lock:
+            if self._scheduler is None:
+                from dmlc_tpu.generate.engine import GenerationEngine
+                from dmlc_tpu.generate.slots import SlotScheduler
+
+                engine = GenerationEngine(
+                    self.model_name,
+                    max_slots=self.max_slots,
+                    page_size=self.page_size,
+                    num_pages=self.num_pages,
+                    max_prefill=self.max_prefill,
+                    use_pallas=self.use_pallas,
+                )
+                self._scheduler = SlotScheduler(
+                    engine,
+                    max_waiting=self.max_waiting,
+                    name=f"generate-{self.model_name}",
+                    metrics=self.metrics,
+                    flight=self.flight,
+                    registry=self.registry,
+                    lane=self.lane,
+                )
+            return self._scheduler
+
+    def submit(self, prompt, **kw):
+        return self._ensure().submit(prompt, **kw)
+
+    def load_variables(self, variables) -> None:
+        """`train`-verb hot-swap into the live engine."""
+        self._ensure().engine.load_variables(variables)
+
+    def summary(self) -> dict:
+        with self._lock:
+            sched = self._scheduler
+        return sched.summary() if sched is not None else {"built": False}
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        with self._lock:
+            sched = self._scheduler
+        if sched is not None:
+            sched.stop(timeout_s=timeout_s)
+
+
+class _Session:
+    __slots__ = ("stream", "last_poll")
+
+    def __init__(self, stream, now: float):
+        self.stream = stream
+        self.last_poll = now
+
+
+class GenerateWorker:
+    """RPC surface over a dict of GenerationBackends."""
+
+    def __init__(self, backends: dict, *, session_ttl_s: float = 120.0,
+                 clock=time.monotonic):
+        self.backends = dict(backends)
+        self.session_ttl_s = float(session_ttl_s)
+        self.clock = clock
+        self._sessions: dict[str, _Session] = {}
+        self._lock = threading.Lock()
+
+    def methods(self) -> dict:
+        return traced_methods({
+            "job.generate": self._generate,
+            "job.generate_poll": self._poll,
+            "job.generate_cancel": self._cancel,
+        })
+
+    def _backend(self, model: str) -> GenerationBackend:
+        backend = self.backends.get(model)
+        if backend is None:
+            raise RpcError(
+                f"model {model!r} not served here; have {sorted(self.backends)}"
+            )
+        return backend
+
+    def _generate(self, p: dict) -> dict:
+        backend = self._backend(p["model"])
+        gen_id = os.urandom(8).hex()
+        try:
+            stream = backend.submit(
+                [int(t) for t in p["prompt"]],
+                max_new_tokens=int(p["max_new_tokens"]),
+                temperature=float(p.get("temperature", 0.0)),
+                eos_id=int(p["eos_id"]) if p.get("eos_id") is not None else None,
+                request_id=gen_id,
+            )
+        except ValueError as e:
+            raise RpcError(str(e))
+        now = self.clock()
+        with self._lock:
+            self._sweep_locked(now)
+            self._sessions[gen_id] = _Session(stream, now)
+        return {"gen_id": gen_id, "model": p["model"]}
+
+    def _poll(self, p: dict) -> dict:
+        gen_id = p["gen_id"]
+        now = self.clock()
+        with self._lock:
+            session = self._sessions.get(gen_id)
+            if session is None:
+                raise RpcError(f"unknown generation {gen_id!r} (done+acked, "
+                               "cancelled, or expired)")
+            session.last_poll = now
+        # The session is NOT popped on the final reply: if that reply is
+        # lost, the client's retried poll must find the same idempotent
+        # done-verdict, not "unknown generation". TTL sweep (and explicit
+        # cancel) reap it instead.
+        return session.stream.chunks_after(int(p.get("ack", 0)))
+
+    def _cancel(self, p: dict) -> dict:
+        with self._lock:
+            session = self._sessions.pop(p["gen_id"], None)
+        # The slots remain driven to completion (mid-step cancellation is a
+        # follow-up; the slot's max_new_tokens bounds the wasted work) —
+        # cancel only releases the chunk retention.
+        return {"cancelled": session is not None}
+
+    def _sweep_locked(self, now: float) -> None:
+        dead = [
+            gid for gid, s in self._sessions.items()
+            if now - s.last_poll > self.session_ttl_s
+        ]
+        for gid in dead:
+            self._sessions.pop(gid, None)
+        if dead:
+            log.info("swept %d abandoned generation session(s)", len(dead))
+
+    def summary(self) -> dict:
+        with self._lock:
+            open_sessions = len(self._sessions)
+        return {
+            "open_sessions": open_sessions,
+            "models": {name: b.summary() for name, b in self.backends.items()},
+        }
+
+
+# ---------------------------------------------------------------------------
+# Client helpers (CLI / tests / tools)
+# ---------------------------------------------------------------------------
+
+
+def generate_stream(
+    rpc,
+    addr: str,
+    model: str,
+    prompt,
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    eos_id: int | None = None,
+    poll_timeout: float = 10.0,
+    poll_interval_s: float = 0.0,
+    sleep=time.sleep,
+) -> Iterator[int]:
+    """Submit and yield tokens as they stream. Exactly-once: chunks are
+    dedup'd by seq and acked cumulatively, so a retried poll after a lost
+    reply cannot duplicate or drop tokens. Raises the remote's typed error
+    (Overloaded / DeadlineExceeded / RpcError) on failure."""
+    from dmlc_tpu.cluster.rpc import remote_error
+
+    with tracer.span("cli/generate", model=model):
+        reply = rpc.call(
+            addr, "job.generate",
+            {"model": model, "prompt": [int(t) for t in prompt],
+             "max_new_tokens": int(max_new_tokens),
+             "temperature": float(temperature), "eos_id": eos_id},
+            timeout=poll_timeout,
+        )
+        gen_id = reply["gen_id"]
+        acked = 0
+        while True:
+            r = rpc.call(
+                addr, "job.generate_poll", {"gen_id": gen_id, "ack": acked},
+                timeout=poll_timeout,
+            )
+            advanced = False
+            for seq, toks in sorted(r.get("chunks", [])):
+                if seq <= acked:
+                    continue  # replayed chunk from a retried poll
+                acked = seq
+                advanced = True
+                for t in toks:
+                    yield int(t)
+            if r.get("done") and not r.get("chunks"):
+                if r.get("error"):
+                    raise remote_error(r["error"])
+                return
+            if not advanced and not r.get("done") and poll_interval_s > 0:
+                sleep(poll_interval_s)
+
+
+def generate(rpc, addr, model, prompt, **kw) -> list[int]:
+    """Blocking convenience: the full generated token list."""
+    return list(generate_stream(rpc, addr, model, prompt, **kw))

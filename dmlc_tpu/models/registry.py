@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from dmlc_tpu.models.alexnet import alexnet
 from dmlc_tpu.models.clip import clip_vit_b32, clip_vit_l14
+from dmlc_tpu.models.lm import LM_SMALL_MAX_LEN, LM_SMALL_VOCAB, lm_small
 from dmlc_tpu.models.resnet import resnet18, resnet34, resnet50
 from dmlc_tpu.models.vit import vit_b16, vit_l14
 
@@ -26,17 +27,26 @@ from dmlc_tpu.models.vit import vit_b16, vit_l14
 class ModelSpec:
     name: str
     build: Callable[..., Any]          # (dtype=...) -> nn.Module
-    input_size: int                    # square image side
-    num_outputs: int                   # classes, or embedding dim for encoders
+    input_size: int                    # square image side; max_len for kind="lm"
+    num_outputs: int                   # classes / embedding dim; vocab for "lm"
     classifier: bool = True            # False => embedding model (no top-1/accuracy)
+    kind: str = "image"                # "image" | "lm" (autoregressive decode)
 
     def module(self, dtype=jnp.bfloat16):
+        if self.kind == "lm":
+            return self.build(dtype=dtype)
         if self.classifier:
             return self.build(num_classes=self.num_outputs, dtype=dtype)
         return self.build(dtype=dtype)
 
     def init_params(self, rng, dtype=jnp.bfloat16, batch_size: int = 1):
         model = self.module(dtype=dtype)
+        if self.kind == "lm":
+            # Any token length yields the full parameter tree (the embed
+            # tables are sized by the module's vocab/max_len, not the
+            # example), so init with a short dummy sequence.
+            dummy = jnp.zeros((batch_size, 8), jnp.int32)
+            return model, model.init(rng, dummy)
         dummy = jnp.zeros((batch_size, self.input_size, self.input_size, 3), jnp.float32)
         return model, model.init(rng, dummy, train=False)
 
@@ -67,5 +77,13 @@ for _spec in [
     ModelSpec("vit_l14", vit_l14, 224, 1000),
     ModelSpec("clip_vit_l14", clip_vit_l14, 224, 768, classifier=False),
     ModelSpec("clip_vit_b32", clip_vit_b32, 224, 512, classifier=False),
+    # Servable causal LM for the generation engine (dmlc_tpu/generate/):
+    # init from seed, weights hot-swapped via the SDFS models/<name> blob
+    # path like every other entry. input_size carries max_len, num_outputs
+    # the vocab.
+    ModelSpec(
+        "lm_small", lm_small, LM_SMALL_MAX_LEN, LM_SMALL_VOCAB,
+        classifier=False, kind="lm",
+    ),
 ]:
     register(_spec)

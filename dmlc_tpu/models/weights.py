@@ -54,6 +54,9 @@ def variables_template(model_name: str):
     no compilation, and cached: every model.load RPC validates against it."""
     spec = get_model(model_name)
     model = spec.module(dtype=jnp.float32)
+    if spec.kind == "lm":
+        dummy_tokens = jnp.zeros((1, 8), jnp.int32)
+        return jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), dummy_tokens))
     dummy = jnp.zeros((1, spec.input_size, spec.input_size, 3), jnp.float32)
     return jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), dummy, train=False))
 

@@ -612,9 +612,13 @@ class ModelLoader:
     refuse cleanly.
     """
 
-    def __init__(self, store, backends: dict):
+    def __init__(self, store, backends: dict, extra: dict | None = None):
         self.store = store
         self.backends = backends
+        # A second live backend table (e.g. the generation backends): the
+        # `train` verb hot-swaps LM weights the same way it swaps image
+        # weights. Predict backends win a (never-expected) name collision.
+        self.extra = extra if extra is not None else {}
 
     def methods(self) -> dict:
         return traced_methods({"model.load": self._load})
@@ -623,7 +627,7 @@ class ModelLoader:
         from dmlc_tpu.models import weights as weights_lib
 
         model = p["model"]
-        backend = self.backends.get(model)
+        backend = self.backends.get(model, self.extra.get(model))
         if backend is None:
             raise RpcError(f"model {model!r} not served here")
         if not hasattr(backend, "load_variables"):

@@ -151,6 +151,26 @@ class ClusterConfig:
     # (models/export.py): members need only the artifact + weights blobs.
     serve_from_executable: bool = False
 
+    # --- generation serving (dmlc_tpu/generate/, docs/GENERATE.md) ---
+    # Registry LMs (kind="lm", e.g. "lm_small") this node serves through
+    # the continuous-batching generation worker. Empty = no generation
+    # surface (the default; image-only nodes pay nothing).
+    generate_models: list[str] = field(default_factory=list)
+    # Slot table size: the decode step's FIXED batch shape — requests join/
+    # leave between steps, the compiled program never reshapes.
+    gen_max_slots: int = 8
+    # Paged KV cache geometry: tokens per page, pages in the pool (page 0
+    # is reserved scratch), and the padded prefill length (prompts longer
+    # than gen_max_prefill are refused).
+    gen_page_size: int = 16
+    gen_num_pages: int = 128
+    gen_max_prefill: int = 64
+    # Requests allowed to WAIT for a slot beyond the table itself before
+    # submits shed with a typed Overloaded (0 = shed at a full table).
+    gen_max_waiting: int = 8
+    # Streamed-chunk retention for a client that stopped polling.
+    gen_session_ttl_s: float = 120.0
+
     # --- control-plane authentication (cluster/auth.py) ---
     # Shared fleet key: every RPC frame and gossip datagram carries an
     # HMAC-SHA256 tag, and unauthenticated frames are dropped — reaching a

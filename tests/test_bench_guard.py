@@ -538,3 +538,77 @@ class TestTrainGuard:
         out = bench.annotate_train_entries(
             {"lm_flash_train": {"tokens_per_sec_per_chip": 2845.0}}, {})
         assert "degraded_vs_history" not in out["lm_flash_train"]
+
+
+class TestLmDecodeGuard:
+    """ISSUE 7: the lm_decode leg is guarded like flash/train — a degraded
+    window's tok/s never replaces a healthy committed entry, and a
+    deliberate slot/page-geometry change is judged fresh."""
+
+    OLD = {"continuous8": {"slots": 8, "requests": 16, "prompt": 128,
+                           "max_new": 128, "page_size": 64,
+                           "tokens_per_sec": 5200.0, "token_p50_ms": 12.1,
+                           "slot_occupancy": 0.81}}
+
+    def test_collapsed_entry_flagged_and_merge_keeps_healthy(self):
+        new = bench.annotate_lm_decode_entries(
+            {"continuous8": {"slots": 8, "requests": 16, "prompt": 128,
+                             "max_new": 128, "page_size": 64,
+                             "tokens_per_sec": 240.0, "token_p50_ms": 260.0}},
+            self.OLD)
+        assert new["continuous8"]["degraded_vs_history"] is True
+        assert new["continuous8"]["best_tokens_per_sec"] == 5200.0
+        merged = bench.merge_detail({"configs": [], "lm_decode": new},
+                                    {"configs": [], "lm_decode": self.OLD})
+        assert merged["lm_decode"]["continuous8"]["tokens_per_sec"] == 5200.0
+        assert merged["lm_decode"]["continuous8"]["stale"] is True
+
+    def test_healthy_advances_best(self):
+        new = bench.annotate_lm_decode_entries(
+            {"continuous8": {"slots": 8, "requests": 16, "prompt": 128,
+                             "max_new": 128, "page_size": 64,
+                             "tokens_per_sec": 6100.0}},
+            self.OLD)
+        assert "degraded_vs_history" not in new["continuous8"]
+        assert new["continuous8"]["best_tokens_per_sec"] == 6100.0
+        merged = bench.merge_detail({"configs": [], "lm_decode": new},
+                                    {"configs": [], "lm_decode": self.OLD})
+        assert merged["lm_decode"]["continuous8"]["tokens_per_sec"] == 6100.0
+        assert "stale" not in merged["lm_decode"]["continuous8"]
+
+    def test_geometry_change_judged_fresh(self):
+        new = bench.annotate_lm_decode_entries(
+            {"continuous8": {"slots": 16, "requests": 16, "prompt": 128,
+                             "max_new": 128, "page_size": 64,
+                             "tokens_per_sec": 900.0}},
+            self.OLD)
+        assert "degraded_vs_history" not in new["continuous8"]
+
+    def test_skipped_leg_keeps_previous_stamped_stale(self):
+        merged = bench.merge_detail({"configs": [], "lm_decode": {}},
+                                    {"configs": [], "lm_decode": self.OLD})
+        assert merged["lm_decode"]["continuous8"]["tokens_per_sec"] == 5200.0
+        assert merged["lm_decode"]["continuous8"]["stale"] is True
+
+    def test_no_history_never_flags(self):
+        out = bench.annotate_lm_decode_entries(
+            {"continuous8": {"tokens_per_sec": 240.0}}, {})
+        assert "degraded_vs_history" not in out["continuous8"]
+
+
+def test_bench_lm_decode_leg_smoke():
+    """The leg itself runs (tiny lm_small geometry on CPU) and records the
+    fields the guard keys on plus the gen/step span aggregates."""
+    import pytest
+
+    pytest.importorskip("jax")
+    out = bench.bench_lm_decode(
+        model="lm_small", slots=2, n_req=3, prompt_len=6, max_new=4,
+        page_size=8, entry_name="smoke",
+    )
+    entry = out["smoke"]
+    assert entry["tokens"] == 3 * 4
+    assert entry["tokens_per_sec"] > 0
+    assert entry["token_p50_ms"] is not None
+    assert "gen/step" in entry["span_aggregates"]
+    assert entry["sheds"] == 0

@@ -1,0 +1,371 @@
+"""Continuous-batching slot scheduler + RPC streaming (ISSUE 7 pins).
+
+- the continuous-batching pin: N >= 8 concurrent generations with mixed
+  prompt/output lengths complete CORRECTLY (token-identical to isolated
+  runs) while sharing one running batch, slots observed joining/leaving
+  between steps (flight-recorder step stamps), and measured tok/s >= 2x
+  the sequential one-request-at-a-time baseline on the same model;
+- typed Overloaded sheds at a full slot table and an exhausted page pool;
+- deadline-carrying: expired budgets exit slots with a ``deadline:`` error;
+- mid-decode page exhaustion evicts with a typed Overloaded error and a
+  ``slot_evict`` flight event;
+- seeded join/leave soak over the sim fabric with EXACTLY-ONCE token
+  delivery through the chunk-poll protocol (replayed polls are idempotent,
+  ack truncation is permanent). DMLC_CHAOS_SEED offsets the soak's seeds
+  (the CI chaos matrix runs this file across its seed legs).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from dmlc_tpu.cluster.deadline import Deadline  # noqa: E402
+from dmlc_tpu.cluster.flight import FlightRecorder  # noqa: E402
+from dmlc_tpu.cluster.rpc import (  # noqa: E402
+    DeadlineExceeded,
+    Overloaded,
+    SimRpcNetwork,
+)
+from dmlc_tpu.generate.engine import GenerationEngine  # noqa: E402
+from dmlc_tpu.generate.slots import SlotScheduler  # noqa: E402
+from dmlc_tpu.generate.worker import (  # noqa: E402
+    GenerateWorker,
+    GenerationBackend,
+    generate,
+)
+from dmlc_tpu.models.registry import get_model  # noqa: E402
+from dmlc_tpu.utils.metrics import Counters  # noqa: E402
+
+SEED_BASE = int(os.environ.get("DMLC_CHAOS_SEED", "0"))
+SPEC = get_model("lm_small")
+VOCAB = SPEC.num_outputs
+
+
+@pytest.fixture(scope="module")
+def variables():
+    _, v = SPEC.init_params(jax.random.PRNGKey(0), dtype=jnp.float32)
+    return v
+
+
+def make_engine(variables, **kw):
+    kw.setdefault("max_slots", 8)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("num_pages", 128)
+    kw.setdefault("max_prefill", 16)
+    return GenerationEngine("lm_small", variables=variables, **kw)
+
+
+def reference_tokens(variables, prompt, n_new):
+    """Isolated greedy reference for one request."""
+    eng = make_engine(variables, max_slots=1)
+    toks = [eng.join(0, np.asarray(prompt, np.int32))]
+    for _ in range(n_new - 1):
+        eng.ensure_capacity(0)
+        toks.append(int(eng.step()[0]))
+    return toks
+
+
+class TestContinuousBatchingPin:
+    def test_concurrent_correct_and_2x_over_sequential(self, variables):
+        rng = np.random.default_rng(100 + SEED_BASE)
+        n_req = 10  # > max_slots so late joins enter a mid-decode batch
+        reqs = [
+            (
+                rng.integers(0, VOCAB, size=int(rng.integers(3, 12))).tolist(),
+                int(rng.integers(6, 14)),
+            )
+            for _ in range(n_req)
+        ]
+        refs = [reference_tokens(variables, p, n) for p, n in reqs]
+
+        def run_phase(concurrent: bool):
+            flight = FlightRecorder()
+            eng = make_engine(variables)
+            sched = SlotScheduler(eng, max_waiting=n_req, flight=flight)
+            # Warm the compile caches outside the timed window.
+            sched.submit([1, 2, 3], max_new_tokens=2).result(timeout=30)
+            t0 = time.perf_counter()
+            outs = []
+            if concurrent:
+                streams = [
+                    sched.submit(p, max_new_tokens=n) for p, n in reqs
+                ]
+                outs = [s.result(timeout=60) for s in streams]
+            else:
+                for p, n in reqs:
+                    outs.append(
+                        sched.submit(p, max_new_tokens=n).result(timeout=60)
+                    )
+            dt = time.perf_counter() - t0
+            steps = eng.steps
+            tok_total = sum(len(o) for o in outs)
+            sched.stop()
+            return outs, dt, steps, tok_total, flight
+
+        outs_c, dt_c, steps_c, toks_c, flight = run_phase(concurrent=True)
+        outs_s, dt_s, steps_s, toks_s, _ = run_phase(concurrent=False)
+
+        # Correctness: every request's tokens match its isolated reference
+        # despite sharing the batch with strangers — in BOTH phases.
+        assert outs_c == refs
+        assert outs_s == refs
+
+        # Slots join AND leave between steps of one running batch: admits
+        # stamped at step > 0 (joined mid-decode) and exits at distinct
+        # steps while the batch kept running.
+        events = flight.events()
+        admits = [e for e in events if e["kind"] == "slot_admit"]
+        exits = [e for e in events if e["kind"] == "slot_exit"]
+        assert any(e["step"] > 0 for e in admits), "no slot joined mid-batch"
+        exit_steps = {e["step"] for e in exits}
+        assert len(exit_steps) > 1, "all slots exited at the same step"
+
+        # Step-count economics: sequential pays ~sum(tokens) steps, the
+        # shared batch ~max(tokens) per generation wave.
+        assert steps_s >= 2 * steps_c, (steps_s, steps_c)
+        # The measured pin: continuous batching >= 2x sequential tok/s.
+        tok_s_c = toks_c / dt_c
+        tok_s_s = toks_s / dt_s
+        assert tok_s_c >= 2.0 * tok_s_s, (
+            f"continuous {tok_s_c:.1f} tok/s vs sequential {tok_s_s:.1f}"
+        )
+
+
+class TestOverloadContract:
+    def test_slot_table_full_sheds_typed(self, variables):
+        eng = make_engine(variables, max_slots=2)
+        metrics = Counters()
+        flight = FlightRecorder()
+        sched = SlotScheduler(
+            eng, max_waiting=0, metrics=metrics, flight=flight
+        )
+        try:
+            streams = [
+                sched.submit([1, 2, 3], max_new_tokens=64) for _ in range(2)
+            ]
+            with pytest.raises(Overloaded) as e:
+                sched.submit([1, 2, 3], max_new_tokens=4)
+            assert e.value.retry_after_s is not None
+            assert metrics.get("shed") == 1
+            assert any(ev["kind"] == "shed" for ev in flight.events())
+            for s in streams:
+                s.result(timeout=60)
+        finally:
+            sched.stop()
+
+    def test_page_pool_exhaustion_sheds_typed(self, variables):
+        # 8-token pages, 3 usable pages: a 14-token prompt reserves 2, the
+        # next one cannot reserve its 2 and must shed with retry-after.
+        eng = make_engine(variables, num_pages=4, page_size=8)
+        sched = SlotScheduler(eng, max_waiting=8)
+        try:
+            first = sched.submit(list(range(14)), max_new_tokens=2)
+            with pytest.raises(Overloaded, match="page pool"):
+                sched.submit(list(range(14)), max_new_tokens=2)
+            first.result(timeout=60)
+        finally:
+            sched.stop()
+
+    def test_mid_decode_exhaustion_evicts_typed(self, variables):
+        # 3 usable pages. Slot A: 14-token prompt (2 pages), 10 new tokens
+        # (crosses into a 3rd page at length 16). Slot B: 7-token prompt
+        # (the 3rd page), crosses its boundary at length 8 — FIRST, with
+        # the pool empty: B is evicted with a typed Overloaded while A
+        # rides B's recycled page to completion. The deferred-start
+        # scheduler makes the admission order deterministic.
+        flight = FlightRecorder()
+        metrics = Counters()
+        eng = make_engine(variables, num_pages=4, page_size=8)
+        sched = SlotScheduler(
+            eng, max_waiting=8, metrics=metrics, flight=flight, autostart=False
+        )
+        try:
+            a = sched.submit(list(range(14)), max_new_tokens=10)
+            b = sched.submit(list(range(7)), max_new_tokens=8)
+            sched.start()
+            assert len(a.result(timeout=60)) == 10
+            with pytest.raises(Overloaded, match="evicted"):
+                b.result(timeout=60)
+            assert any(e["kind"] == "slot_evict" for e in flight.events())
+            assert metrics.get("gen_evictions") == 1
+            # Eviction + completion recycled everything: the pool is whole.
+            assert eng.pages_free == eng.cache.allocator.pages_total
+        finally:
+            sched.stop()
+
+    def test_deadline_carried_and_enforced(self, variables):
+        eng = make_engine(variables)
+        sched = SlotScheduler(eng, max_waiting=8)
+        try:
+            stream = sched.submit(
+                [1, 2, 3], max_new_tokens=200, deadline=Deadline(0.05),
+            )
+            with pytest.raises(DeadlineExceeded):
+                stream.result(timeout=60)
+        finally:
+            sched.stop()
+
+    def test_submit_validates_against_engine_limits(self, variables):
+        eng = make_engine(variables, max_prefill=8)
+        sched = SlotScheduler(eng)
+        try:
+            with pytest.raises(ValueError, match="max_prefill"):
+                sched.submit(list(range(9)), max_new_tokens=2)
+            with pytest.raises(ValueError, match="max_tokens"):
+                sched.submit([1], max_new_tokens=10_000)
+            with pytest.raises(ValueError):
+                sched.submit([], max_new_tokens=2)
+        finally:
+            sched.stop()
+
+
+class TestExactlyOnceStreaming:
+    """The chunk-poll protocol over the sim fabric."""
+
+    def _worker(self, variables, **backend_kw):
+        backend_kw.setdefault("max_slots", 4)
+        backend_kw.setdefault("page_size", 8)
+        backend_kw.setdefault("num_pages", 128)
+        backend_kw.setdefault("max_prefill", 16)
+        backend_kw.setdefault("max_waiting", 64)
+        backend = GenerationBackend("lm_small", **backend_kw)
+        # Inject the prebuilt engine path: warm by building via _ensure
+        # and swapping seed-matched variables for determinism.
+        backend.warmup()
+        backend.load_variables(variables)
+        worker = GenerateWorker({"lm_small": backend})
+        net = SimRpcNetwork()
+        net.serve("member", worker.methods())
+        return backend, worker, net
+
+    def test_poll_replay_is_idempotent(self, variables):
+        backend, worker, net = self._worker(variables)
+        try:
+            cli = net.client("cli")
+            reply = cli.call(
+                "member", "job.generate",
+                {"model": "lm_small", "prompt": [1, 2, 3], "max_new_tokens": 5},
+            )
+            gid = reply["gen_id"]
+            # Wait for completion, then poll twice WITHOUT acking: the
+            # replay must return identical chunks.
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                r1 = cli.call("member", "job.generate_poll",
+                              {"gen_id": gid, "ack": 0})
+                if r1["done"]:
+                    break
+                time.sleep(0.01)
+            r2 = cli.call("member", "job.generate_poll", {"gen_id": gid, "ack": 0})
+            assert r1["chunks"] == r2["chunks"] and r1["done"]
+            # Cumulative ack truncates for good.
+            last_seq = r1["chunks"][-1][0]
+            r3 = cli.call("member", "job.generate_poll",
+                          {"gen_id": gid, "ack": last_seq})
+            assert r3["chunks"] == [] and r3["done"] and not r3.get("error")
+        finally:
+            backend.stop()
+
+    def test_seeded_join_leave_soak_exactly_once(self, variables):
+        """Concurrent clients churning through the worker: every request's
+        reassembled stream equals its isolated greedy reference, token for
+        token — no duplicates, no gaps, no cross-slot bleed."""
+        backend, worker, net = self._worker(variables)
+        try:
+            rng = np.random.default_rng(200 + SEED_BASE)
+            reqs = [
+                (
+                    rng.integers(0, VOCAB, size=int(rng.integers(2, 15))).tolist(),
+                    int(rng.integers(1, 10)),
+                )
+                for _ in range(16)
+            ]
+            refs = [reference_tokens(variables, p, n) for p, n in reqs]
+            results: dict[int, list[int]] = {}
+            errors: dict[int, Exception] = {}
+
+            def run(i):
+                p, n = reqs[i]
+                try:
+                    results[i] = generate(
+                        net.client(f"cli{i}"), "member", "lm_small", p,
+                        max_new_tokens=n, poll_interval_s=0.002,
+                    )
+                except Exception as e:  # collected and asserted below
+                    errors[i] = e
+
+            threads = [
+                threading.Thread(target=run, args=(i,)) for i in range(len(reqs))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert not errors, errors
+            assert results == {i: refs[i] for i in range(len(reqs))}
+            # All pages recycled once the fleet of requests drained.
+            eng = backend._scheduler.engine
+            assert eng.pages_free == eng.cache.allocator.pages_total
+            assert eng.jit_cache_sizes() == {"step": 1, "prefill": 1}
+        finally:
+            backend.stop()
+
+    def test_unknown_model_and_session_are_rpc_errors(self, variables):
+        from dmlc_tpu.cluster.rpc import RpcError
+
+        backend, worker, net = self._worker(variables)
+        try:
+            cli = net.client("cli")
+            with pytest.raises(RpcError, match="not served here"):
+                cli.call("member", "job.generate",
+                         {"model": "nope", "prompt": [1], "max_new_tokens": 1})
+            with pytest.raises(RpcError, match="unknown generation"):
+                cli.call("member", "job.generate_poll",
+                         {"gen_id": "missing", "ack": 0})
+        finally:
+            backend.stop()
+
+
+class TestNodeIntegration:
+    def test_node_serves_generate_end_to_end(self, tmp_path):
+        """A real ClusterNode with generate_models wired: the CLI verb
+        streams a generation through the member RPC server, and the
+        metric gauges/status surface the new plane."""
+        from dmlc_tpu.cli import Cli
+        from dmlc_tpu.cluster.localcluster import (
+            start_local_cluster,
+            stop_local_cluster,
+            wait_until,
+        )
+
+        nodes = start_local_cluster(
+            tmp_path, 1,
+            n_leader_candidates=1,
+            generate_models=["lm_small"],
+            gen_page_size=8,
+            gen_num_pages=64,
+            gen_max_prefill=16,
+            eager_load=False,
+        )
+        try:
+            node = nodes[0]
+            wait_until(lambda: node.standby.is_leader, msg="leader promotion")
+            reply = node.generate("lm_small", [1, 2, 3], max_new_tokens=5)
+            assert len(reply["tokens"]) == 5
+            assert all(0 <= t < VOCAB for t in reply["tokens"])
+            snap = node.registry.snapshot()
+            assert "generate-lm_small_slots_active" in snap["gauges"]
+            assert "generate-lm_small_tok_s" in snap["gauges"]
+            status = node.status(remote=False)
+            assert status["generate"]["models"]["lm_small"]["completions"] == 1
+            out = Cli(node).run_command("generate lm_small 1 2 3 --max-new 3")
+            assert "3 token(s)" in out
+        finally:
+            stop_local_cluster(nodes)

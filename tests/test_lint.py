@@ -166,6 +166,36 @@ def test_j2_silent_on_hoisted_jit():
     assert fired(src, "dmlc_tpu/parallel/x.py") == []
 
 
+def test_j2_fires_on_decode_loop_rejit():
+    # ISSUE 7 fixture: a generation decode loop that re-jits its step per
+    # token recompiles every iteration — the exact hazard the engine's
+    # build-once ``_step`` avoids (tests/test_generate.py pins the runtime
+    # side: ONE jit cache entry across a whole join/leave soak).
+    src = """
+    import jax
+
+    def serve_generation(engine, active):
+        while active():
+            step = jax.jit(engine.step_fn)  # recompiles per token!
+            step()
+    """
+    assert fired(src, "dmlc_tpu/generate/x.py") == ["J2"]
+
+
+def test_j2_silent_on_decode_loop_with_prebuilt_step():
+    src = """
+    import jax
+
+    def build_step(step_fn):
+        return jax.jit(step_fn, donate_argnums=(1, 2))
+
+    def serve_generation(step, active):
+        while active():
+            step()
+    """
+    assert fired(src, "dmlc_tpu/generate/x.py") == []
+
+
 def test_j2_suppression_on_preceding_line():
     src = """
     import jax
@@ -432,6 +462,45 @@ def test_h1_silent_on_unmarked_and_on_cached_pool_use():
         return list(_host_pool().map(str, paths))
     """
     assert fired(src, "dmlc_tpu/ops/x.py") == []
+
+
+def test_h1_fires_on_page_allocator_built_per_decode_call():
+    # ISSUE 7 fixture: the paged-KV allocator/cache/engine allocate the
+    # whole device page pool and compile the decode step — building one
+    # inside a hot path is the generation plane's per-call-pool regression.
+    src = """
+    from dmlc_tpu.generate.kvcache import PageAllocator, PagedKVCache
+
+    from dmlc_tpu.utils.hotpath import hot_path
+
+    @hot_path
+    def decode_step(slots):
+        alloc = PageAllocator(num_pages=64, page_size=16)  # rebuilt per step!
+        cache = PagedKVCache(num_layers=2, num_pages=64, page_size=16,
+                             num_heads=2, head_dim=64, max_slots=8,
+                             max_pages_per_slot=16)
+        return alloc, cache
+    """
+    assert fired(src, "dmlc_tpu/generate/x.py") == ["H1", "H1"]
+
+
+def test_h1_silent_on_engine_scope_allocator():
+    # The correct shape (GenerationEngine.__init__ builds the cache once;
+    # the hot path only drives it).
+    src = """
+    from dmlc_tpu.generate.engine import GenerationEngine
+
+    from dmlc_tpu.utils.hotpath import hot_path
+
+    class Backend:
+        def __init__(self):
+            self.engine = GenerationEngine("lm_small")  # once, not hot
+
+        @hot_path
+        def decode_step(self):
+            return self.engine.step()
+    """
+    assert fired(src, "dmlc_tpu/generate/x.py") == []
 
 
 def test_h1_suppression_with_justification():

@@ -23,10 +23,11 @@
 #   8. chaos matrix     — the seeded fault-injection suites (crashes,
 #                         partitions, failover, disk bit-rot/torn writes,
 #                         overload: deadlines/shedding/breakers/gray
-#                         ejection) across a 3-seed-base matrix: each leg
-#                         offsets every parametrized seed range into a
-#                         disjoint region of the fault space
-#                         (DMLC_CHAOS_SEED)
+#                         ejection, and the generation join/leave soak
+#                         with exactly-once token delivery) across a
+#                         3-seed-base matrix: each leg offsets every
+#                         parametrized seed range into a disjoint region
+#                         of the fault space (DMLC_CHAOS_SEED)
 #
 # Tools the image does not ship (ruff, mypy, clang-tidy) are SKIPPED with
 # a notice instead of failing the gate — the repo must not depend on
@@ -100,15 +101,16 @@ else
   fail=1
 fi
 
-note "chaos suite (3-seed matrix: crashes/partitions/failover x disk faults x overload)"
+note "chaos suite (3-seed matrix: crashes/partitions/failover x disk faults x overload x generation soak)"
 for seed_base in 0 1000 2000; do
   note "chaos matrix leg DMLC_CHAOS_SEED=$seed_base"
   if env JAX_PLATFORMS=cpu DMLC_CHAOS_SEED="$seed_base" python -m pytest \
       tests/test_chaos.py tests/test_sdfs_faults.py tests/test_overload.py \
+      tests/test_generate_cluster.py \
       -q -p no:cacheprovider; then
     note "chaos leg $seed_base OK"
   else
-    note "chaos leg $seed_base FAILED (replay: DMLC_CHAOS_SEED=$seed_base pytest tests/test_chaos.py tests/test_sdfs_faults.py tests/test_overload.py)"
+    note "chaos leg $seed_base FAILED (replay: DMLC_CHAOS_SEED=$seed_base pytest tests/test_chaos.py tests/test_sdfs_faults.py tests/test_overload.py tests/test_generate_cluster.py)"
     fail=1
   fi
 done

@@ -16,6 +16,13 @@ constructing ``ThreadPoolExecutor`` / ``ProcessPoolExecutor`` /
 once at module or object scope (``ops/preprocess._host_pool``,
 ``parallel/inference._stage_pool``) and submit to it instead.
 
+The generation plane extends the same invariant to its once-per-lifetime
+objects: ``PageAllocator`` / ``PagedKVCache`` / ``GenerationEngine``
+(dmlc_tpu/generate/) allocate the whole device page pool and compile the
+decode step — constructing one inside a hot path rebuilds the KV pool and
+recompiles per request. Build them at engine/backend scope (the
+GenerationBackend pattern) and drive them from the hot path.
+
 The C++ twin of this invariant — no ``std::thread``-per-call in
 ``native/image_pipeline.cpp`` — is enforced structurally by the persistent
 ``DecodePool`` plus its concurrent-submitter TSan/ASan smoke
@@ -38,6 +45,14 @@ _POOL_CTORS = {
     "concurrent.futures.process.ProcessPoolExecutor": "ProcessPoolExecutor",
     "threading.Thread": "threading.Thread",
     "multiprocessing.Pool": "multiprocessing.Pool",
+    # Generation-plane equivalents (dmlc_tpu/generate/): the page
+    # allocator / paged cache / engine allocate the whole device page pool
+    # and compile the decode programs — built once per serving lifetime;
+    # per-hot-path construction is the same steady-state churn as a
+    # per-call thread pool (and a recompile per request besides).
+    "dmlc_tpu.generate.kvcache.PageAllocator": "PageAllocator",
+    "dmlc_tpu.generate.kvcache.PagedKVCache": "PagedKVCache",
+    "dmlc_tpu.generate.engine.GenerationEngine": "GenerationEngine",
 }
 
 

@@ -2,13 +2,16 @@
 end to end on a real localcluster.
 
 Starts a 3-node localcluster on loopback (real TCP + gossip), enables
-tracing, runs the predict workload to completion, collects the merged
-fleet trace through the obs.* RPC surface (clock alignment included), and
+tracing, runs the predict workload to completion, drives one ``generate``
+request through the continuous-batching worker, collects the merged fleet
+trace through the obs.* RPC surface (clock alignment included), and
 asserts the committed contract:
 
 - the merged artifact loads as Chrome/Perfetto trace-event JSON,
 - spans from >= 2 distinct node lanes (pids) share one trace_id,
-- no child span starts before its parent after alignment.
+- no child span starts before its parent after alignment,
+- the generate request produced ``gen/step`` spans PARENTED into its
+  ``rpc/job.generate`` trace (docs/GENERATE.md's tracing contract).
 
 Exit 0 on success; nonzero with a diagnostic otherwise.
 """
@@ -42,6 +45,11 @@ def main() -> int:
         synset_path=make_synsets(tmp / "synsets.txt", 24),
         job_models=["resnet18"],
         dispatch_shard_size=4,
+        generate_models=["lm_small"],
+        gen_page_size=8,
+        gen_num_pages=64,
+        gen_max_prefill=16,
+        eager_load=False,  # the one lm_small engine builds on first use
     )
     try:
         leader = nodes[0]
@@ -59,6 +67,11 @@ def main() -> int:
             timeout=60.0,
             msg="workload finished",
         )
+        # One generation through the continuous-batching worker: its
+        # gen/step spans must land in the fleet trace, parented under the
+        # request's rpc/job.generate span.
+        gen_reply = leader.generate("lm_small", [1, 2, 3], max_new_tokens=4)
+        assert len(gen_reply["tokens"]) == 4, gen_reply
         out = tmp / "fleet_trace.json"
         observe.export_fleet_trace(
             leader.rpc, sorted(leader.active_member_addrs()), out
@@ -93,9 +106,31 @@ def main() -> int:
     if bad:
         print(f"trace smoke FAILED: children before parents: {bad}", file=sys.stderr)
         return 1
+    # Generation contract: the generate request produced gen/step spans,
+    # and every one is PARENTED (carries a parent edge) inside the same
+    # trace as an rpc/job.generate span.
+    gen_steps = [e for e in events if e["name"] == "gen/step"]
+    gen_rpc_traces = {
+        e["args"].get("trace") for e in events if e["name"] == "rpc/job.generate"
+    }
+    if not gen_steps:
+        print("trace smoke FAILED: no gen/step spans recorded", file=sys.stderr)
+        return 1
+    orphans = [
+        e for e in gen_steps
+        if not e["args"].get("parent") or e["args"].get("trace") not in gen_rpc_traces
+    ]
+    if orphans:
+        print(
+            f"trace smoke FAILED: {len(orphans)}/{len(gen_steps)} gen/step "
+            "span(s) not parented into a rpc/job.generate trace",
+            file=sys.stderr,
+        )
+        return 1
     print(
         f"trace smoke OK: {len(events)} spans, {len(by_trace)} traces, "
-        f"{len(multi_node)} crossing >= 2 nodes"
+        f"{len(multi_node)} crossing >= 2 nodes, "
+        f"{len(gen_steps)} parented gen/step span(s)"
     )
     return 0
 
