@@ -1151,7 +1151,7 @@ def bench_e2e(
     # bench_detail.json ("span_aggregates"), so a future BENCH_*.json delta
     # can be attributed to a STAGE (decode vs stage vs dispatch vs sync)
     # instead of just observed at the headline.
-    e2e_s = serial_s = stage_seconds = span_aggregates = None
+    e2e_s = serial_s = stage_seconds = span_aggregates = profile_snapshot = None
     if time_left() > 0:
         from dmlc_tpu.utils.tracing import tracer
 
@@ -1175,6 +1175,16 @@ def bench_e2e(
             for name, s in tracer.summary().items()
             if isinstance(s, dict) and s.get("count")
         }
+        # The same span aggregates, folded through the live cost profiler
+        # (cluster/profile.py) exactly as the leader's scrape loop folds
+        # obs.metrics replies: the snapshot pins the (model x member x
+        # stage) lane schema a cluster run serves over obs.profile, with
+        # this process standing in as member "local".
+        from dmlc_tpu.cluster.profile import CostProfiler
+
+        profiler = CostProfiler(window_s=60.0, windows=4)
+        profiler.ingest_scrape("local", {"spans": tracer.summary()})
+        profile_snapshot = profiler.snapshot()
         tracer.reset()
         ing = engine.ingest_summary()
         stage_seconds = {
@@ -1224,6 +1234,10 @@ def bench_e2e(
         # span name): the regression-attribution record — when e2e_img_s
         # moves between BENCH_r*.json rounds, diff these to name the stage.
         "span_aggregates": span_aggregates,
+        # obs.profile-shaped cost-profile snapshot of the same leg
+        # (docs/OBSERVABILITY.md §5): the lanes a cluster's placement loop
+        # would see for this workload, grown from the identical scrape path.
+        "profile": profile_snapshot,
     }
 
 

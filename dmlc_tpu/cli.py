@@ -84,6 +84,11 @@ Commands (reference: README.md:10-23):
                                         clock-aligned, one pid lane per node
   flight [member]                       flight-recorder event ring (breaker /
                                         gray / quarantine / shed transitions)
+  profile [member]                      live cost-profile lanes (model x
+                                        member x stage: n/mean/p50/p99/qps);
+                                        the leader's holds the whole fleet
+  slo                                   per-model SLO burn rates + the current
+                                        placement plan (leader's evaluator)
   help                                  this text
   exit | quit                           leave and stop the node
 """
@@ -410,15 +415,18 @@ class Cli:
             if sub == "fleet":
                 if len(args) != 2:
                     return "usage: trace fleet <path>"
-                doc = observe.export_fleet_trace(
-                    n.rpc, sorted(set(n.active_member_addrs()) | {n.self_member_addr}),
-                    args[1],
-                )
+                doc = n.export_fleet_trace(args[1])
                 lanes = {e.get("pid") for e in doc["traceEvents"] if e.get("ph") == "X"}
+                skew = max(
+                    (float(v.get("max_skew_s") or 0.0)
+                     for v in doc["otherData"].get("nodes", {}).values()),
+                    default=0.0,
+                )
                 return (
                     f"wrote merged fleet trace to {args[1]}: "
                     f"{sum(1 for e in doc['traceEvents'] if e.get('ph') == 'X')} "
-                    f"span(s) across {len(lanes)} node lane(s)"
+                    f"span(s) across {len(lanes)} node lane(s), "
+                    f"max clamp skew {skew * 1e3:.2f}ms"
                 )
             if sub == "summary":
                 rows = []
@@ -436,6 +444,75 @@ class Cli:
                     table += f"\nWARNING: {dropped} span(s) dropped past max_events"
                 return table
             return "usage: trace on|off|summary|export <path>|fleet <path>"
+        if cmd == "profile":
+            # Local snapshot by default (any node keeps one — the leader's
+            # holds the fleet's lanes); `profile <member>` asks a peer.
+            if args:
+                snap = n.rpc.call(args[0], "obs.profile", {}, timeout=5.0)
+            else:
+                snap = n.profiler.snapshot()
+            rows = []
+            for model, members in sorted(snap.get("profiles", {}).items()):
+                for member, stages in sorted(members.items()):
+                    for stage, s in sorted(stages.items()):
+                        rows.append([
+                            model, member, stage, s["n"],
+                            f"{s['mean'] * 1e3:.2f}ms",
+                            f"{s['p50'] * 1e3:.2f}ms",
+                            f"{s['p99'] * 1e3:.2f}ms",
+                            f"{s['qps']:.2f}",
+                        ])
+            if not rows:
+                return "no profile lanes yet (profiles grow from dispatches and scrapes)"
+            return format_table(
+                ["model", "member", "stage", "n", "mean", "p50", "p99", "qps"], rows
+            )
+        if cmd == "slo":
+            try:
+                reply = n.rpc.call(n.tracker.current, "obs.slo", {}, timeout=5.0)
+            except Exception as e:
+                return f"leader slo status unavailable: {e}"
+            slo = reply.get("slo") or {}
+            out = []
+            models = slo.get("models") or {}
+            if not models:
+                out.append("no SLO objectives configured (config.slo_objectives)")
+            else:
+                out.append(
+                    f"windows: fast={slo['fast_window_s']:.0f}s "
+                    f"(burn >= {slo['fast_burn_threshold']:.0f}x pages), "
+                    f"slow={slo['slow_window_s']:.0f}s "
+                    f"(burn >= {slo['slow_burn_threshold']:.0f}x pages)"
+                )
+                rows = []
+                for model, s in sorted(models.items()):
+                    p99 = s.get("p99_s")
+                    rows.append([
+                        model,
+                        f"{s['objective_latency_s'] * 1e3:.0f}ms"
+                        f"@{s['availability']:.3f}",
+                        f"{p99 * 1e3:.1f}ms" if p99 is not None else "-",
+                        f"{s['fast_burn']:.2f}x",
+                        f"{s['slow_burn']:.2f}x",
+                        "FAST-BURN" if s.get("fast_alert")
+                        else ("slow-burn" if s.get("slow_alert") else "ok"),
+                    ])
+                out.append(format_table(
+                    ["model", "objective", "p99", "fast burn", "slow burn", "state"],
+                    rows,
+                ))
+            placement = reply.get("placement") or {}
+            if placement:
+                excluded = placement.get("excluded") or []
+                assignment = placement.get("assignment") or {}
+                out.append(
+                    f"placement: moves {placement.get('moves_used', 0)}"
+                    f"/{placement.get('max_moves', 0)} this window, excluded: "
+                    + (", ".join(excluded) if excluded else "(none)")
+                )
+                for name, ms in sorted(assignment.items()):
+                    out.append(f"  {name}: {', '.join(ms)}")
+            return "\n".join(out)
         if cmd == "help":
             return HELP
         if cmd in ("exit", "quit"):

@@ -36,11 +36,13 @@ from dmlc_tpu.cluster.failover import LeaderTracker, StandbyLeader
 from dmlc_tpu.cluster.flight import FlightRecorder
 from dmlc_tpu.cluster.membership import MembershipNode
 from dmlc_tpu.cluster.observe import ObsService
+from dmlc_tpu.cluster.profile import CostProfiler
 from dmlc_tpu.cluster.retrypolicy import RetryPolicy
 from dmlc_tpu.cluster.rpc import TcpRpc, TcpRpcServer
 from dmlc_tpu.cluster.sdfs import MemberStore, SdfsClient, SdfsLeader, SdfsMember
 from dmlc_tpu.cluster.transport import UdpTransport
 from dmlc_tpu.scheduler.jobs import JobScheduler
+from dmlc_tpu.scheduler.placement import PlacementAdvisor, SloEvaluator, SloObjective
 from dmlc_tpu.scheduler.worker import (
     DynamicBatcher,
     EngineBackend,
@@ -143,6 +145,25 @@ class ClusterNode:
         # Latest obs.metrics reply per member, scraped by the leader on the
         # probe cadence (empty on non-leading nodes).
         self.fleet_metrics: dict[str, dict] = {}
+        # Live cost profiles (cluster/profile.py): every node keeps one —
+        # members feed their own gen/step lane, the leader additionally
+        # folds dispatch latencies + fleet scrapes into fleet-wide lanes.
+        # Warm-started from the persisted snapshot so a restarted leader
+        # places from evidence instead of re-learning the fleet from zero.
+        self.profiler = CostProfiler(
+            window_s=config.profile_window_s,
+            windows=config.profile_windows,
+            decay=config.profile_decay,
+            clock=self.clock.monotonic,
+        )
+        if config.profile_persist:
+            adopted = self.profiler.load(self.profile_path())
+            if adopted:
+                self.flight.note("profile_warm_start", lanes=adopted)
+        # Worst clamp distance seen in the last merged fleet trace (set by
+        # export_fleet_trace below); 0 until a trace has been collected.
+        self._trace_max_skew = 0.0
+        self.registry.gauge("trace_max_skew_s", lambda: self._trace_max_skew)
 
         # --- L1 membership over UDP gossip -----------------------------
         self.gossip = UdpTransport(config.host, config.gossip_port, auth=self.auth)
@@ -200,6 +221,11 @@ class ClusterNode:
                     flight=self.flight,
                     registry=self.registry,
                     lane=lambda: self.lane,
+                    # Decode-step costs land in this node's own profile
+                    # lane; the leader's scrape folds them fleet-wide.
+                    profile=lambda sec, m=name: self.profiler.record(
+                        m, self.lane, "gen/step", sec
+                    ),
                 )
                 for name in config.generate_models
             }
@@ -209,7 +235,10 @@ class ClusterNode:
         self.model_loader = ModelLoader(
             self.store, self.worker.backends, extra=self._gen_backends
         )
-        self.obs = ObsService(self.registry, flight=self.flight, lane=self.lane)
+        self.obs = ObsService(
+            self.registry, flight=self.flight, lane=self.lane,
+            profiler=self.profiler,
+        )
         methods = traced_methods({
             **self.sdfs_member.methods(),
             **self.worker.methods(),
@@ -244,6 +273,8 @@ class ClusterNode:
         self.scheduler = None
         self.standby = None
         self.mesh_bootstrap = None
+        self.advisor = None
+        self.slo = None
         if self.is_candidate:
             self._start_leader_services()
 
@@ -324,6 +355,20 @@ class ClusterNode:
             transfer_timeout_s=self.config.transfer_deadline_s,
         )
         self._weight_cache: dict[str, tuple[int, float]] = {}
+        # Profile-driven placement (scheduler/placement.py): consulted by
+        # every assignment pass; falls back to round-robin whenever the
+        # profiles are too thin to advise.
+        if self.config.placement_enabled:
+            self.advisor = PlacementAdvisor(
+                self.profiler,
+                flight=self.flight,
+                metrics=self.metrics,
+                clock=self.clock.monotonic,
+                max_moves=self.config.placement_max_moves,
+                window_s=self.config.placement_window_s,
+                hysteresis=self.config.placement_hysteresis,
+                exclude_factor=self.config.placement_exclude_factor,
+            )
         self.scheduler = JobScheduler(
             self.rpc,
             self.active_member_addrs,
@@ -339,7 +384,27 @@ class ClusterNode:
             gray_probe_interval_s=self.config.gray_probe_interval_s,
             metrics=self.metrics,
             flight=self.flight,
+            profiler=self.profiler,
+            advisor=self.advisor,
         )
+        # SLO burn-rate evaluation (scheduler/placement.SloEvaluator): runs
+        # on the scrape cadence while leading; a fast-burn edge asks the
+        # scheduler for a replan — the closed loop the objectives exist for.
+        if self.config.slo_objectives:
+            self.slo = SloEvaluator(
+                self.profiler,
+                SloObjective.from_config(self.config.slo_objectives),
+                fast_window_s=self.config.slo_fast_window_s,
+                slow_window_s=self.config.slo_slow_window_s,
+                fast_burn=self.config.slo_fast_burn,
+                slow_burn=self.config.slo_slow_burn,
+                metrics=self.metrics,
+                flight=self.flight,
+                registry=self.registry,
+                on_fast_burn=lambda model: self.scheduler.request_replan(
+                    f"slo_fast_burn:{model}"
+                ),
+            )
         methods = {
             **self.sdfs_leader.methods(),
             **self.scheduler.methods(),
@@ -350,6 +415,12 @@ class ClusterNode:
                 "obs.fleet": lambda p: {"fleet": dict(self.fleet_metrics)},
                 "obs.fleet_prom": lambda p: {
                     "text": observe.render_fleet_prometheus(dict(self.fleet_metrics))
+                },
+                "obs.slo": lambda p: {
+                    "slo": self.slo.status() if self.slo is not None else {},
+                    "placement": (
+                        self.advisor.status() if self.advisor is not None else {}
+                    ),
                 },
             }),
         }
@@ -491,6 +562,30 @@ class ClusterNode:
         base = Path(self.config.storage_dir)
         return base.parent / (base.name + ".flight.json")
 
+    def profile_path(self) -> Path:
+        """Where this node's cost-profile snapshot persists (same sibling
+        convention as the flight dump) for restart warm-start."""
+        base = Path(self.config.storage_dir)
+        return base.parent / (base.name + ".profile.json")
+
+    def export_fleet_trace(self, path: str | Path) -> dict:
+        """Collect + write one merged fleet trace (CLI ``trace fleet``),
+        with this node's flight recorder armed for the skew-clamp alarm;
+        the worst residual skew lands in the ``trace_max_skew_s`` gauge."""
+        doc = observe.export_fleet_trace(
+            self.rpc,
+            sorted(set(self.active_member_addrs()) | {self.self_member_addr}),
+            path,
+            flight=self.flight,
+            skew_alert_s=self.config.trace_skew_alert_s,
+        )
+        nodes = doc.get("otherData", {}).get("nodes", {})
+        self._trace_max_skew = max(
+            (float(v.get("max_skew_s") or 0.0) for v in nodes.values()),
+            default=0.0,
+        )
+        return doc
+
     def stop(self) -> None:
         self._stop.set()
         for b in self._batchers:
@@ -503,6 +598,8 @@ class ClusterNode:
         if self.leader_server is not None:
             self.leader_server.close()
         self.gossip.close()
+        if self.config.profile_persist:
+            self.profiler.save(self.profile_path())
         self.flight.note("node_stop")
         self.flight.dump(self.flight_dump_path(), reason="stop")
 
@@ -632,12 +729,22 @@ class ClusterNode:
         """Leader-side fleet metrics scrape (docs/OBSERVABILITY.md): while
         leading, pull every active member's ``obs.metrics`` on the probe
         cadence and keep the latest reply — ``obs.fleet``/``obs.fleet_prom``
-        and the CLI ``metrics fleet`` verb read from here."""
+        and the CLI ``metrics fleet`` verb read from here. Each pass also
+        closes the profile loop: scrapes fold into the leader's cost
+        profiler, the SLO evaluator re-judges the burn rates, and the
+        profile snapshot persists for warm-start."""
 
         def body():
-            self.fleet_metrics = observe.scrape_fleet_metrics(
+            fleet = observe.scrape_fleet_metrics(
                 self.rpc, self.active_member_addrs(), timeout=2.0
             )
+            self.fleet_metrics = fleet
+            for addr, reply in fleet.items():
+                self.profiler.ingest_scrape(addr, reply)
+            if self.slo is not None:
+                self.slo.evaluate()
+            if self.config.profile_persist:
+                self.profiler.save(self.profile_path())
 
         self._loop(
             self.config.leader_probe_interval_s,

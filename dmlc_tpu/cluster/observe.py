@@ -44,10 +44,14 @@ class ObsService:
     this node executed, so co-hosted nodes (the localcluster harness) each
     report their own timeline."""
 
-    def __init__(self, registry: Registry, flight=None, lane: str | None = None):
+    def __init__(self, registry: Registry, flight=None, lane: str | None = None,
+                 profiler=None):
         self.registry = registry
         self.flight = flight
         self.lane = lane
+        # Live cost profiles (cluster/profile.py): the leader's instance
+        # holds fleet-wide lanes; a member's holds its own (gen/step etc.).
+        self.profiler = profiler
 
     def methods(self) -> dict:
         return traced_methods({
@@ -56,6 +60,7 @@ class ObsService:
             "obs.trace_dump": self._trace_dump,
             "obs.trace_ctl": self._trace_ctl,
             "obs.flight": self._flight,
+            "obs.profile": self._profile,
         })
 
     def _metrics(self, p: dict) -> dict:
@@ -86,6 +91,11 @@ class ObsService:
             return {"events": [], "recorded": 0, "dropped": 0, "capacity": 0}
         return self.flight.to_wire()
 
+    def _profile(self, p: dict) -> dict:
+        if self.profiler is None:
+            return {"profiles": {}}
+        return self.profiler.snapshot()
+
 
 # ---------------------------------------------------------------------------
 # Leader-side collection + merge
@@ -113,7 +123,8 @@ def measure_clock_offset(
 
 
 def collect_fleet_trace(
-    rpc: Rpc, addrs: list[str], timeout: float = 10.0, clock_samples: int = 5
+    rpc: Rpc, addrs: list[str], timeout: float = 10.0, clock_samples: int = 5,
+    flight=None, skew_alert_s: float = 0.0,
 ) -> dict:
     """Pull every node's span dump + clock offset and merge them into one
     Chrome/Perfetto trace document. Unreachable nodes are skipped (named in
@@ -131,26 +142,40 @@ def collect_fleet_trace(
         except (RpcUnreachable, RpcError) as e:
             unreachable[addr] = str(e)
             log.warning("fleet trace: %s unreachable: %s", addr, e)
-    return merge_fleet_trace(per_node, unreachable=unreachable)
+    return merge_fleet_trace(
+        per_node, unreachable=unreachable, flight=flight,
+        skew_alert_s=skew_alert_s,
+    )
 
 
-def merge_fleet_trace(per_node: dict, unreachable: dict | None = None) -> dict:
+def merge_fleet_trace(
+    per_node: dict, unreachable: dict | None = None, flight=None,
+    skew_alert_s: float = 0.0,
+) -> dict:
     """Merge per-node dumps (``{addr: {"dump": obs.trace_dump reply,
     "offset": s, "rtt": s}}``) into one trace-event document: one pid per
     node (process_name metadata = its address), every timestamp translated
     into the collector's timebase (``local = remote - offset``), and child
     spans clamped to start no earlier than their parent — the residual
     skew after alignment is sub-RTT, and a child rendered before its parent
-    would read as causality violated when it is only clock noise."""
+    would read as causality violated when it is only clock noise.
+
+    Clamping is corrective, so its MAGNITUDE is the health signal: each
+    node's worst clamp distance lands in ``otherData.nodes[addr]
+    .max_skew_s``, and any node past ``skew_alert_s`` (when > 0) records a
+    ``trace_skew_clamp`` flight event — clock-alignment decay must be
+    visible before it quietly corrupts every profile built on the spans."""
     events: list[dict] = []
     meta: list[dict] = []
     dropped_total = 0
     span_start: dict[str, float] = {}  # span_id -> aligned start (seconds)
     parsed: list[tuple[int, dict, float]] = []
+    addr_of: dict[int, str] = {}
     for pid, (addr, entry) in enumerate(sorted(per_node.items())):
         offset = float(entry.get("offset", 0.0))
         dump = entry["dump"]
         dropped_total += int(dump.get("dropped", 0))
+        addr_of[pid] = addr
         meta.append({
             "name": "process_name", "ph": "M", "pid": pid,
             "args": {"name": addr},
@@ -163,11 +188,16 @@ def merge_fleet_trace(per_node: dict, unreachable: dict | None = None) -> dict:
                 # nodes can both report an unlaned span.
                 span_start.setdefault(e["span"], start)
     clamped = 0
+    max_skew: dict[str, float] = {addr: 0.0 for addr in per_node}
+    clamped_by: dict[str, int] = {addr: 0 for addr in per_node}
     for pid, e, start in parsed:
         parent = e.get("parent")
         if parent is not None and parent in span_start:
             floor = span_start[parent]
             if start < floor:
+                addr = addr_of[pid]
+                max_skew[addr] = max(max_skew[addr], floor - start)
+                clamped_by[addr] += 1
                 start = floor
                 clamped += 1
         args = dict(e.get("attrs") or {})
@@ -184,10 +214,19 @@ def merge_fleet_trace(per_node: dict, unreachable: dict | None = None) -> dict:
             "args": args,
         })
     other: dict = {
-        "nodes": {a: {"offset_s": v.get("offset"), "rtt_s": v.get("rtt")}
+        "nodes": {a: {"offset_s": v.get("offset"), "rtt_s": v.get("rtt"),
+                      "max_skew_s": max_skew.get(a, 0.0)}
                   for a, v in sorted(per_node.items())},
         "skew_clamped_children": clamped,
     }
+    if skew_alert_s > 0 and flight is not None:
+        for addr in sorted(max_skew):
+            if max_skew[addr] > skew_alert_s:
+                flight.note(
+                    "trace_skew_clamp", node=addr,
+                    max_skew_s=round(max_skew[addr], 6),
+                    clamped=clamped_by[addr], threshold_s=skew_alert_s,
+                )
     if dropped_total:
         other["dropped_events"] = dropped_total
         other["note"] = "one or more nodes truncated their span buffer"
@@ -197,12 +236,15 @@ def merge_fleet_trace(per_node: dict, unreachable: dict | None = None) -> dict:
 
 
 def export_fleet_trace(
-    rpc: Rpc, addrs: list[str], path: str | Path, timeout: float = 10.0
+    rpc: Rpc, addrs: list[str], path: str | Path, timeout: float = 10.0,
+    flight=None, skew_alert_s: float = 0.0,
 ) -> dict:
     """Collect + write one merged fleet trace; returns the document."""
     from dmlc_tpu.cluster.diskio import atomic_write
 
-    doc = collect_fleet_trace(rpc, addrs, timeout=timeout)
+    doc = collect_fleet_trace(
+        rpc, addrs, timeout=timeout, flight=flight, skew_alert_s=skew_alert_s
+    )
     # Atomic even though this is an operator artifact: a half-written trace
     # looks exactly like a Perfetto parser bug to the person debugging.
     atomic_write(Path(path), json.dumps(doc).encode())

@@ -177,6 +177,7 @@ class SlotScheduler:
         clock=monotonic,
         autostart: bool = True,
         lane=None,
+        profile=None,
     ):
         self.engine = engine
         self.name = name
@@ -184,6 +185,9 @@ class SlotScheduler:
         self.flight = flight
         self.retry_after_s = float(retry_after_s)
         self.clock = clock
+        # Cost-profile feed (cluster/profile.py): called with each decode
+        # step's wall seconds so the node's profiler grows a gen/step lane.
+        self.profile = profile
         # Node identity for span attribution (utils/tracing.lane): the
         # decode thread does not inherit the RPC server's ambient lane, so
         # it binds its own. A callable defers resolution to thread start
@@ -423,7 +427,10 @@ class SlotScheduler:
         with tracectx.bind(oldest.trace_ctx):
             with tracer.span("gen/step", slots=len(self._resident)):
                 tokens = self.engine.step()
-        self.step_stats.record(max(0.0, self.clock() - t0))
+        elapsed = max(0.0, self.clock() - t0)
+        self.step_stats.record(elapsed)
+        if self.profile is not None:
+            self.profile(elapsed)
         for req in list(self._resident):
             tok = int(tokens[req.slot])
             self._deliver(req, tok)

@@ -56,6 +56,7 @@ class GenerationBackend:
         flight=None,
         registry=None,
         lane=None,
+        profile=None,
     ):
         self.model_name = model_name
         self.max_slots = int(max_slots)
@@ -68,6 +69,7 @@ class GenerationBackend:
         self.flight = flight
         self.registry = registry
         self.lane = lane
+        self.profile = profile
         self._scheduler = None
         self._lock = threading.Lock()
 
@@ -99,6 +101,7 @@ class GenerationBackend:
                     flight=self.flight,
                     registry=self.registry,
                     lane=self.lane,
+                    profile=self.profile,
                 )
             return self._scheduler
 

@@ -212,6 +212,8 @@ class JobScheduler:
         gray_probe_interval_s: float = 5.0,
         metrics: Counters | None = None,
         flight=None,
+        profiler=None,
+        advisor=None,
     ):
         import time
 
@@ -241,6 +243,17 @@ class JobScheduler:
         # restorations, and gang job stops are the transitions a postmortem
         # reconstructs first.
         self.flight = flight
+        # Closed-loop placement (docs/OBSERVABILITY.md §5): the profiler
+        # receives every dispatch's measured cost; the advisor turns those
+        # profiles into assignment plans consulted by assign_once. Either
+        # None keeps the round-robin baseline (the sim tests' default).
+        self.profiler = profiler
+        self.advisor = advisor
+        # Replan trigger: set by gray transitions, membership changes, and
+        # the SLO evaluator's fast-burn callback; consumed (and cleared) by
+        # the next assignment pass so the advisor knows WHY it ran.
+        self._replan_trigger: str | None = None
+        self._last_member_set: frozenset = frozenset()
         # member addr -> {"ewma", "demoted", "reason", "last_probe",
         # "opens_mark"} (leader-local; a new leader re-learns the fleet).
         self._health: dict[str, dict] = {}
@@ -389,6 +402,15 @@ class JobScheduler:
         weights = {m: max(1, int(self.member_weight(m))) for m in members}
         with self._lock:
             self._gray_check()
+            trigger = self._replan_trigger
+            self._replan_trigger = None
+            member_set = frozenset(members)
+            if member_set != self._last_member_set:
+                # Join/leave is a replan trigger in its own right: the
+                # advisor must re-solve, budget or not.
+                if self._last_member_set:
+                    trigger = trigger or "membership"
+                self._last_member_set = member_set
             if not group and self.demoted:
                 kept = [m for m in members if m not in self.demoted]
                 members = kept or members
@@ -404,6 +426,10 @@ class JobScheduler:
                     self.jobs[name].assigned = sorted(group)
                     self.jobs[name].dispatch_pool = []
                 return
+            if self.advisor is not None and self._assign_from_plan(
+                running, members, weights, trigger
+            ):
+                return
             for i, name in enumerate(running):
                 job = self.jobs[name]
                 job.assigned = [
@@ -414,6 +440,56 @@ class JobScheduler:
                 for r in range(max((weights[m] for m in job.assigned), default=0)):
                     pool.extend(m for m in job.assigned if weights[m] > r)
                 job.dispatch_pool = pool
+
+    def _assign_from_plan(
+        self, running: list[str], members: list[str],
+        weights: dict[str, int], trigger: str | None,
+    ) -> bool:
+        """Consult the placement advisor (caller holds the lock; the
+        advisor is non-blocking and leaf-locked by contract). Applies the
+        plan and returns True, or returns False for the round-robin
+        fallback when the advisor abstains or the plan is unusable. Every
+        applied CHANGE stamps the flight recorder — profile-driven
+        placement must never be invisible (lint O2)."""
+        plan = self.advisor.advise(
+            {n: len(self.jobs[n].queries) - self.jobs[n].finished for n in running},
+            members,
+            chip_weight=weights,
+            trigger=trigger or "periodic",
+        )
+        if plan is None:
+            return False
+        member_set = set(members)
+        for name in running:
+            assigned = plan.assignment.get(name)
+            if not assigned or any(m not in member_set for m in assigned):
+                return False  # incomplete/stale plan: round-robin this pass
+        changed = False
+        for name in running:
+            job = self.jobs[name]
+            assigned = sorted(plan.assignment[name])
+            if assigned != job.assigned:
+                changed = True
+            job.assigned = assigned
+            wmap = plan.weights.get(name) or {}
+            w = {m: max(1, int(wmap.get(m, weights.get(m, 1)))) for m in assigned}
+            pool: list[str] = []
+            for r in range(max(w.values(), default=0)):
+                pool.extend(m for m in assigned if w[m] > r)
+            job.dispatch_pool = pool
+        if changed and self.flight is not None:
+            self.flight.note(
+                "placement_apply", trigger=trigger or "periodic",
+                moves=plan.moves, excluded=",".join(plan.excluded),
+            )
+        return True
+
+    def request_replan(self, reason: str) -> None:
+        """Ask the next assignment pass to consult the advisor with an
+        explicit trigger (SLO fast-burn, gray transitions, membership).
+        Safe from any thread; last reason wins."""
+        with self._lock:
+            self._replan_trigger = reason
 
     # ---- gray-failure ejection (docs/OVERLOAD.md) ----------------------
 
@@ -449,6 +525,7 @@ class JobScheduler:
         tracer.record("overload/gray_demote", 0.0, member=member, reason=reason)
         if self.flight is not None:
             self.flight.note("gray_demote", member=member, reason=reason, detail=detail)
+        self._replan_trigger = f"gray_demote:{member}"
         log.warning("gray-demoting %s: %s", member, detail)
 
     def _restore(self, member: str) -> None:
@@ -462,6 +539,7 @@ class JobScheduler:
         tracer.record("overload/gray_restore", 0.0, member=member)
         if self.flight is not None:
             self.flight.note("gray_restore", member=member)
+        self._replan_trigger = f"gray_restore:{member}"
         log.warning("gray-restoring %s: recovered", member)
 
     def _gray_check(self) -> None:
@@ -885,6 +963,15 @@ class JobScheduler:
                 self.retry_policy.record(member, e)
             if isinstance(e, DeadlineExceeded):
                 self.metrics.inc("deadline_exceeded")
+                if self.profiler is not None:
+                    # A timed-out shard IS cost evidence: the member burned
+                    # at least the full budget. Without this, a member slow
+                    # enough to blow every deadline never accrues a profile
+                    # and placement cannot act on it.
+                    self.profiler.record(
+                        job.model_name, member, "dispatch",
+                        self.timer() - t0, count=len(shard),
+                    )
             elif isinstance(e, Overloaded):
                 self.metrics.inc("shed_observed")
             with self._lock:
@@ -933,6 +1020,14 @@ class JobScheduler:
             if member is not None:
                 job.member_stats.setdefault(member, LatencyStats()).record(elapsed)
                 self._observe_member(member, elapsed)
+                if self.profiler is not None:
+                    # The live cost lane placement runs on: one shard's
+                    # leader-measured dispatch RTT, amortized over its
+                    # queries (profiler lock is a leaf; safe held here).
+                    self.profiler.record(
+                        job.model_name, member, "dispatch", elapsed,
+                        count=len(shard),
+                    )
             job.buffered[offset] = (preds, elapsed)
             while job.finished in job.buffered:
                 p, dt = job.buffered.pop(job.finished)

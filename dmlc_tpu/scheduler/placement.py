@@ -1,0 +1,481 @@
+"""SLO burn-rate monitoring + profile-driven placement (the control loop).
+
+This module spends the observability plane: ``CostProfiler`` lanes
+(cluster/profile.py) feed two decision-makers the JobScheduler consults —
+
+- **SloEvaluator** — per-model latency/availability objectives declared in
+  ClusterConfig (``slo_objectives``). Burn rate is the SRE-workbook form:
+  the fraction of observations over the latency objective, divided by the
+  error budget (1 - availability target), over two horizons — a *fast*
+  window that catches cliffs in minutes and a *slow* window that catches
+  smolder. Alert transitions (with hysteresis, so a fleet hovering at the
+  line does not flap) land in the flight recorder, the metrics counters,
+  and per-model registry gauges; a fast-burn transition also pings the
+  scheduler to replan placement NOW instead of on the next periodic pass.
+
+- **PlacementAdvisor** — solves model -> member assignment from measured
+  per-member dispatch cost instead of blind round-robin. Greedy
+  cost-balancing: members whose decayed mean cost exceeds
+  ``exclude_factor`` x the fleet median are excluded (with a re-entry
+  hysteresis band so a recovering member must come well back under the
+  line), the rest are dealt to jobs by capacity (chip weight / measured
+  cost), and dispatch-pool weights scale inversely with cost so a slow
+  member that stays assigned still receives proportionally fewer shards.
+  Plans are throttled by a max-moves-per-window budget and a relative
+  improvement threshold — rebalancing is itself a disturbance, and an
+  advisor that reshuffles the fleet every tick is worse than round-robin.
+
+Every decision stamps the flight recorder (lint rule O2 enforces this for
+any future profile-reading scheduler path): placement must never be
+invisible in a postmortem.
+
+Both classes are sans-IO (injected clocks, no RPC, leaf locks only) so the
+seeded sim soak (tests/test_placement.py) drives the whole loop —
+degradation -> fast burn -> replan -> recovery — on the virtual clock.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from time import monotonic
+from typing import Callable
+
+log = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# SLO evaluation: multi-window burn rates
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SloObjective:
+    """One model's serving objective: ``latency_s`` is the per-shard
+    dispatch latency bound, ``availability`` the target fraction of
+    dispatches under it (error budget = 1 - availability)."""
+
+    model: str
+    latency_s: float
+    availability: float = 0.99
+
+    @property
+    def error_budget(self) -> float:
+        return max(1e-9, 1.0 - self.availability)
+
+    @classmethod
+    def from_config(cls, objectives: dict) -> "dict[str, SloObjective]":
+        """Parse the ClusterConfig ``slo_objectives`` mapping
+        (``{model: {"latency_s": s, "availability": a}}``)."""
+        out: dict[str, SloObjective] = {}
+        for model, spec in (objectives or {}).items():
+            out[model] = cls(
+                model=model,
+                latency_s=float(spec["latency_s"]),
+                availability=float(spec.get("availability", 0.99)),
+            )
+        return out
+
+
+class SloEvaluator:
+    """Evaluates burn rates from profiler lanes on every call (the leader
+    runs it on the scrape cadence). Stateful only for alert edges."""
+
+    # An alert clears only once burn falls below this fraction of its
+    # threshold: hysteresis against flapping at the line.
+    CLEAR_FRACTION = 0.5
+
+    def __init__(
+        self,
+        profiler,
+        objectives: dict[str, SloObjective],
+        *,
+        fast_window_s: float = 300.0,
+        slow_window_s: float = 3600.0,
+        fast_burn: float = 14.0,
+        slow_burn: float = 2.0,
+        stage: str = "dispatch",
+        metrics=None,
+        flight=None,
+        registry=None,
+        on_fast_burn: Callable[[str], None] | None = None,
+    ):
+        self.profiler = profiler
+        self.objectives = dict(objectives)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.fast_burn = float(fast_burn)
+        self.slow_burn = float(slow_burn)
+        self.stage = stage
+        self.metrics = metrics
+        self.flight = flight
+        self.on_fast_burn = on_fast_burn
+        # model -> {"fast": burn, "slow": burn, "fast_alert": bool, ...}
+        self._state: dict[str, dict] = {
+            m: {"fast": 0.0, "slow": 0.0, "fast_alert": False, "slow_alert": False}
+            for m in self.objectives
+        }
+        self._lock = threading.Lock()
+        if registry is not None:
+            for model in self.objectives:
+                registry.gauge(
+                    f"slo_fast_burn_{model}",
+                    lambda m=model: self._state[m]["fast"],
+                )
+                registry.gauge(
+                    f"slo_slow_burn_{model}",
+                    lambda m=model: self._state[m]["slow"],
+                )
+
+    def _burn(self, obj: SloObjective, horizon_s: float) -> float:
+        frac = self.profiler.frac_over(
+            obj.latency_s, model=obj.model, stage=self.stage, horizon_s=horizon_s
+        )
+        return frac / obj.error_budget
+
+    def evaluate(self) -> dict[str, dict]:
+        """One evaluation pass over every objective. Returns the per-model
+        state after the pass. Alert edge-transitions record flight events
+        and counters; entering fast burn fires ``on_fast_burn`` (after the
+        evaluator's own lock is released — the callback takes the
+        scheduler's lock)."""
+        fired: list[str] = []
+        with self._lock:
+            for model, obj in sorted(self.objectives.items()):
+                st = self._state[model]
+                st["fast"] = self._burn(obj, self.fast_window_s)
+                st["slow"] = self._burn(obj, self.slow_window_s)
+                for win, threshold in (("fast", self.fast_burn),
+                                       ("slow", self.slow_burn)):
+                    alert_key = f"{win}_alert"
+                    if not st[alert_key] and st[win] >= threshold:
+                        st[alert_key] = True
+                        if self.metrics is not None:
+                            self.metrics.inc(f"slo_{win}_burn_alerts")
+                        if self.flight is not None:
+                            self.flight.note(
+                                f"slo_{win}_burn", model=model,
+                                burn=round(st[win], 3), threshold=threshold,
+                                objective_s=obj.latency_s,
+                            )
+                        log.warning("SLO %s burn for %s: %.1fx budget "
+                                    "(threshold %.1fx)", win, model, st[win],
+                                    threshold)
+                        if win == "fast":
+                            fired.append(model)
+                    elif st[alert_key] and st[win] <= self.CLEAR_FRACTION * threshold:
+                        st[alert_key] = False
+                        if self.flight is not None:
+                            self.flight.note(
+                                "slo_burn_clear", model=model, window=win,
+                                burn=round(st[win], 3),
+                            )
+            out = {m: dict(st) for m, st in self._state.items()}
+        if self.on_fast_burn is not None:
+            for model in fired:
+                self.on_fast_burn(model)
+        return out
+
+    def status(self) -> dict:
+        """The ``obs.slo`` reply / CLI ``slo`` verb payload."""
+        with self._lock:
+            state = {m: dict(st) for m, st in self._state.items()}
+        out: dict = {
+            "fast_window_s": self.fast_window_s,
+            "slow_window_s": self.slow_window_s,
+            "fast_burn_threshold": self.fast_burn,
+            "slow_burn_threshold": self.slow_burn,
+            "models": {},
+        }
+        for model, obj in sorted(self.objectives.items()):
+            st = state.get(model, {})
+            out["models"][model] = {
+                "objective_latency_s": obj.latency_s,
+                "availability": obj.availability,
+                "p99_s": self.profiler.percentile(
+                    99, model=model, stage=self.stage,
+                    horizon_s=self.fast_window_s,
+                ),
+                "fast_burn": st.get("fast", 0.0),
+                "slow_burn": st.get("slow", 0.0),
+                "fast_alert": st.get("fast_alert", False),
+                "slow_alert": st.get("slow_alert", False),
+            }
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Placement: greedy cost-balancing with hysteresis + move budget
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PlacementPlan:
+    """One solved assignment: job -> members, plus per-member dispatch-pool
+    weights (shards land proportionally to weight)."""
+
+    assignment: dict[str, list[str]] = field(default_factory=dict)
+    weights: dict[str, dict[str, int]] = field(default_factory=dict)
+    excluded: list[str] = field(default_factory=list)
+    moves: int = 0
+    trigger: str = ""
+
+
+class PlacementAdvisor:
+    """Turns profiler lanes into assignment plans. ``advise`` is called
+    under the scheduler lock, so it must stay non-blocking and touch only
+    leaf locks (the profiler's, the flight recorder's)."""
+
+    MAX_WEIGHT = 8          # weight amplification cap per member
+    REENTER_FRACTION = 0.7  # an excluded member re-enters below this x line
+
+    def __init__(
+        self,
+        profiler,
+        *,
+        flight=None,
+        metrics=None,
+        clock: Callable[[], float] = monotonic,
+        max_moves: int = 2,
+        window_s: float = 60.0,
+        hysteresis: float = 0.15,
+        exclude_factor: float = 3.0,
+        stage: str = "dispatch",
+    ):
+        self.profiler = profiler
+        self.flight = flight
+        self.metrics = metrics
+        self.clock = clock
+        self.max_moves = int(max_moves)
+        self.window_s = float(window_s)
+        self.hysteresis = float(hysteresis)
+        self.exclude_factor = float(exclude_factor)
+        self.stage = stage
+        self._last_plan: PlacementPlan | None = None
+        self._excluded: set[str] = set()
+        self._moves_used = 0
+        self._window_start: float | None = None
+
+    # ---- cost model ----------------------------------------------------
+
+    def _costs(self, members: list[str]) -> tuple[dict[str, float], float]:
+        """(per-member decayed mean dispatch cost, fleet median over the
+        measured ones). Unmeasured members cost the median (innocent until
+        profiled); with nothing measured anywhere, everyone costs 1.0."""
+        measured = {}
+        for m in members:
+            c = self.profiler.mean_cost(m, stage=self.stage)
+            if c is not None and c > 0:
+                measured[m] = c
+        if measured:
+            ordered = sorted(measured.values())
+            median = ordered[len(ordered) // 2]
+        else:
+            median = 1.0
+        return {m: measured.get(m, median) for m in members}, median
+
+    def _exclusions(self, costs: dict[str, float], median: float) -> set[str]:
+        """Sticky outlier set: enter above ``exclude_factor`` x median,
+        leave below ``REENTER_FRACTION`` x that line (hysteresis). Never
+        excludes down to fewer members than jobs need — availability wins."""
+        line = self.exclude_factor * median
+        out = set()
+        for m, c in sorted(costs.items()):
+            if m in self._excluded:
+                if c > self.REENTER_FRACTION * line:
+                    out.add(m)
+            elif c > line:
+                out.add(m)
+        return out
+
+    @staticmethod
+    def _plan_estimate(plan: PlacementPlan, jobs: dict[str, int],
+                       costs: dict[str, float], chip_weight: dict[str, int]) -> float:
+        """Estimated makespan: max over jobs of demand / service rate,
+        where a member's rate is chips / measured cost."""
+        worst = 0.0
+        for name, members in plan.assignment.items():
+            demand = max(1, jobs.get(name, 0))
+            rate = sum(
+                chip_weight.get(m, 1) / max(1e-9, costs.get(m, 1.0))
+                for m in members
+            )
+            worst = max(worst, demand / rate if rate > 0 else float("inf"))
+        return worst
+
+    # ---- the solver ----------------------------------------------------
+
+    def advise(
+        self,
+        jobs: dict[str, int],
+        members: list[str],
+        chip_weight: dict[str, int] | None = None,
+        trigger: str = "periodic",
+    ) -> PlacementPlan | None:
+        """Solve job -> member placement from current profiles. ``jobs``
+        maps job name to remaining demand (queries left); ``members`` is
+        the eligible fleet (gray-demoted members already removed by the
+        scheduler). Returns None when there is nothing to place (caller
+        keeps its round-robin fallback)."""
+        if not jobs or not members:
+            return None
+        chip_weight = chip_weight or {m: 1 for m in members}
+        costs, median = self._costs(sorted(members))
+        excluded = self._exclusions(costs, median)
+        eligible = [m for m in sorted(members) if m not in excluded]
+        if len(eligible) < len(jobs):
+            # Not enough healthy members to give every job one: re-admit
+            # the cheapest excluded members until every job can be served.
+            readmit = sorted(excluded, key=lambda m: (costs[m], m))
+            while len(eligible) < len(jobs) and readmit:
+                back = readmit.pop(0)
+                excluded.discard(back)
+                eligible.append(back)
+            eligible.sort()
+        self._excluded = set(excluded)
+
+        plan = self._solve(jobs, eligible, costs, chip_weight)
+        plan.excluded = sorted(excluded)
+        plan.trigger = trigger
+
+        previous = self._last_plan
+        plan.moves = self._count_moves(previous, plan)
+        now = self.clock()
+        if self._window_start is None or now - self._window_start >= self.window_s:
+            self._window_start = now
+            self._moves_used = 0
+
+        # A usable cached plan gates the new one behind hysteresis and the
+        # move budget; a STALE one (departed members, missing jobs) never
+        # does — reality already forced the change. Neither does a change
+        # to the EXCLUSION set: exclusions are outlier/SLO-driven removals,
+        # and the throughput estimate below would always score removing a
+        # member as a loss (less capacity), burying the one change the
+        # burn-rate alert exists to force.
+        usable = previous is not None and not self._plan_stale(
+            previous, jobs, set(members)
+        )
+        excluded_changed = previous is not None and (
+            set(plan.excluded) != set(previous.excluded)
+        )
+        if usable and not excluded_changed:
+            if plan.moves == 0 and plan.assignment == previous.assignment:
+                return previous  # identical assignment: keep the cached object
+            # Hysteresis: a reshuffle must buy a real improvement.
+            old_est = self._plan_estimate(previous, jobs, costs, chip_weight)
+            new_est = self._plan_estimate(plan, jobs, costs, chip_weight)
+            improvement = (old_est - new_est) / old_est if old_est > 0 else 0.0
+            if improvement < self.hysteresis:
+                return previous
+            # Move budget: bounded churn per window.
+            if self._moves_used + plan.moves > self.max_moves:
+                if self.metrics is not None:
+                    self.metrics.inc("placement_throttled")
+                if self.flight is not None:
+                    self.flight.note(
+                        "placement_throttled", trigger=trigger,
+                        moves=plan.moves,
+                        budget=self.max_moves - self._moves_used,
+                    )
+                return previous
+
+        self._moves_used += plan.moves
+        self._last_plan = plan
+        if self.metrics is not None:
+            self.metrics.inc("placement_decisions")
+        if self.flight is not None:
+            self.flight.note(
+                "placement_decision",
+                trigger=trigger,
+                moves=plan.moves,
+                excluded=",".join(plan.excluded),
+                assignment=";".join(
+                    f"{n}={len(ms)}" for n, ms in sorted(plan.assignment.items())
+                ),
+            )
+        return plan
+
+    def _solve(
+        self, jobs: dict[str, int], eligible: list[str],
+        costs: dict[str, float], chip_weight: dict[str, int],
+    ) -> PlacementPlan:
+        """Greedy balance: deal members (fastest first) to the job with the
+        highest remaining demand per unit of capacity already granted."""
+        names = sorted(jobs)
+        capacity = {
+            m: chip_weight.get(m, 1) / max(1e-9, costs.get(m, 1.0))
+            for m in eligible
+        }
+        granted = {n: 0.0 for n in names}
+        assignment: dict[str, list[str]] = {n: [] for n in names}
+        for m in sorted(eligible, key=lambda m: (-capacity[m], m)):
+            # Most-starved job first: demand per granted capacity, with
+            # empty jobs infinitely starved so everyone gets one member.
+            target = max(
+                names,
+                key=lambda n: (
+                    float("inf") if not assignment[n]
+                    else max(1, jobs[n]) / max(1e-9, granted[n]),
+                    -len(assignment[n]),
+                    n,
+                ),
+            )
+            assignment[target].append(m)
+            granted[target] += capacity[m]
+        weights: dict[str, dict[str, int]] = {}
+        for n in names:
+            ms = assignment[n]
+            if not ms:
+                weights[n] = {}
+                continue
+            # Normalize to the SLOWEST member: it anchors at weight 1 and
+            # faster peers scale up with 1/cost (capped, so one fast member
+            # cannot starve the interleave of everyone else).
+            worst = max(costs.get(m, 1.0) for m in ms)
+            weights[n] = {
+                m: max(1, min(
+                    self.MAX_WEIGHT * max(1, chip_weight.get(m, 1)),
+                    round(chip_weight.get(m, 1) * worst / max(1e-9, costs.get(m, 1.0))),
+                ))
+                for m in ms
+            }
+        return PlacementPlan(assignment=assignment, weights=weights)
+
+    @staticmethod
+    def _count_moves(previous: PlacementPlan | None, plan: PlacementPlan) -> int:
+        """Members newly added to a job they weren't serving before (the
+        disruptive direction: a move re-points dispatch traffic)."""
+        if previous is None:
+            return 0
+        moves = 0
+        for name, ms in plan.assignment.items():
+            before = set(previous.assignment.get(name, ()))
+            moves += sum(1 for m in ms if m not in before)
+        return moves
+
+    @staticmethod
+    def _plan_stale(previous: PlacementPlan, jobs: dict[str, int],
+                    members: set[str]) -> bool:
+        """A cached plan is unusable (bypasses hysteresis/budget) when it
+        references departed members or misses a job entirely."""
+        for name in jobs:
+            ms = previous.assignment.get(name)
+            if not ms or any(m not in members for m in ms):
+                return True
+        return False
+
+    def status(self) -> dict:
+        plan = self._last_plan
+        return {
+            "excluded": sorted(self._excluded),
+            "moves_used": self._moves_used,
+            "max_moves": self.max_moves,
+            "window_s": self.window_s,
+            "assignment": {} if plan is None else {
+                n: list(ms) for n, ms in sorted(plan.assignment.items())
+            },
+        }
+
+
+__all__ = ["PlacementAdvisor", "PlacementPlan", "SloEvaluator", "SloObjective"]

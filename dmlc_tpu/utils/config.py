@@ -117,6 +117,46 @@ class ClusterConfig:
     gray_min_latency_s: float = 0.25
     gray_probe_interval_s: float = 5.0
 
+    # --- live cost profiles / SLO / placement (docs/OBSERVABILITY.md §5) ---
+    # Rolling profile windows (cluster/profile.py): per-(model x member x
+    # stage) cost lanes the leader folds dispatch latencies and fleet
+    # scrapes into. window_s x windows bounds the history; decay weights
+    # each window by decay**age in every query.
+    profile_window_s: float = 30.0
+    profile_windows: int = 16
+    profile_decay: float = 0.7
+    # Persist the profile (diskio.atomic_write, sibling of storage_dir) so
+    # a restarted leader warm-starts placement instead of re-learning the
+    # fleet from zero. False disables both save and load.
+    profile_persist: bool = True
+    # Per-model serving objectives (scheduler/placement.SloEvaluator):
+    # {model: {"latency_s": shard dispatch latency bound,
+    #          "availability": target fraction under it (default 0.99)}}.
+    # Empty = no SLO evaluation.
+    slo_objectives: dict = field(default_factory=dict)
+    # Multi-window burn-rate alerting: burn = frac-over-objective / error
+    # budget. The fast window catches cliffs (pages in minutes), the slow
+    # window catches smolder; thresholds follow the SRE-workbook shape.
+    slo_fast_window_s: float = 300.0
+    slo_slow_window_s: float = 3600.0
+    slo_fast_burn: float = 14.0
+    slo_slow_burn: float = 2.0
+    # Profile-driven placement (scheduler/placement.PlacementAdvisor):
+    # greedy cost-balanced assignment consulted by every assign pass, with
+    # outlier exclusion past exclude_factor x the fleet median cost, a
+    # relative-improvement hysteresis, and a bounded number of member
+    # moves per window (rebalancing is itself a disturbance). False keeps
+    # the round-robin assignment.
+    placement_enabled: bool = True
+    placement_max_moves: int = 2
+    placement_window_s: float = 60.0
+    placement_hysteresis: float = 0.15
+    placement_exclude_factor: float = 3.0
+    # Fleet-trace clock alignment decay alarm (cluster/observe.py): when
+    # child-before-parent clamping in a merged trace exceeds this residual
+    # skew on any node, a flight event fires (0 disables the alarm).
+    trace_skew_alert_s: float = 0.05
+
     # --- dynamic request micro-batching (scheduler/worker.DynamicBatcher) ---
     # Coalesce concurrent small `job.predict` requests into device-shaped
     # batches: a request waits at most this long for peers before its batch

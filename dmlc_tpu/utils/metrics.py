@@ -15,6 +15,7 @@ target an exact sample list would cross the RPC frame limit within hours.
 
 from __future__ import annotations
 
+import bisect
 import math
 import random
 import re
@@ -60,6 +61,13 @@ class LatencyStats:
     """Streaming duration collector (seconds) with percentile summary."""
 
     RESERVOIR_SIZE = 4096
+    # Fixed log-spaced histogram bounds (seconds). Exact per-bucket counts
+    # complement the reservoir quantiles: buckets aggregate losslessly
+    # across nodes and ship as a proper Prometheus histogram family, so
+    # fleet-wide p99 can be computed server-side (histogram_quantile) even
+    # where a merged reservoir would be an approximation of approximations.
+    BUCKET_BOUNDS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
     def __init__(self, samples: list[float] | None = None):
         self.n = 0
@@ -68,6 +76,8 @@ class LatencyStats:
         self.reservoir: list[float] = []
         self._offers = 0  # reservoir offers seen (Algorithm R denominator)
         self._rng = random.Random(0xD31C)
+        # Per-bucket (non-cumulative) counts; the last slot is +Inf overflow.
+        self.buckets = [0] * (len(self.BUCKET_BOUNDS) + 1)
         if samples:
             self.extend(samples)
 
@@ -98,6 +108,8 @@ class LatencyStats:
         self._mean += delta * count / n2
         self._m2 += delta * delta * count * self.n / n2
         self.n = n2
+        # bisect_left puts value == bound in that bound's bucket (le=bound).
+        self.buckets[bisect.bisect_left(self.BUCKET_BOUNDS, value)] += count
 
     def _reservoir_offer(self, value: float) -> None:
         # Algorithm R: the i-th offer is kept with probability K/i, so the
@@ -136,8 +148,16 @@ class LatencyStats:
         rank = max(1, math.ceil(p / 100.0 * len(xs)))
         return xs[min(rank, len(xs)) - 1]
 
-    def summary(self) -> dict[str, float]:
-        """The reference's report shape: mean/std/median/p90/p95/p99."""
+    def summary(self) -> dict:
+        """The reference's report shape (mean/std/median/p90/p95/p99) plus
+        cumulative histogram bucket counts keyed by upper bound (``le``
+        semantics; ``"+Inf"`` last) — the exact counterpart the Prometheus
+        exposition renders as a histogram family."""
+        cum, buckets = 0, {}
+        for bound, count in zip(self.BUCKET_BOUNDS, self.buckets):
+            cum += count
+            buckets[repr(bound)] = cum
+        buckets["+Inf"] = cum + self.buckets[-1]
         return {
             "count": float(self.n),
             "mean": self.mean,
@@ -146,6 +166,7 @@ class LatencyStats:
             "p90": self.percentile(90),
             "p95": self.percentile(95),
             "p99": self.percentile(99),
+            "buckets": buckets,
         }
 
     def merge(self, other: "LatencyStats") -> None:
@@ -156,6 +177,7 @@ class LatencyStats:
         self._mean += delta * other.n / n2
         self._m2 += other._m2 + delta * delta * self.n * other.n / n2
         self.n = n2
+        self.buckets = [a + b for a, b in zip(self.buckets, other.buckets)]
         self._merge_reservoirs(other)
 
     def _merge_reservoirs(self, other: "LatencyStats") -> None:
@@ -202,6 +224,7 @@ class LatencyStats:
             "m2": self._m2,
             "offers": self._offers,
             "reservoir": list(self.reservoir),
+            "buckets": list(self.buckets),
         }
 
     @classmethod
@@ -214,6 +237,11 @@ class LatencyStats:
         out._m2 = float(w["m2"])
         out.reservoir = [float(x) for x in w["reservoir"]][: cls.RESERVOIR_SIZE]
         out._offers = int(w.get("offers", len(out.reservoir)))
+        # Pre-histogram peers omit buckets; their counts stay zero (the
+        # renderer skips a histogram whose bucket total lags n).
+        wb = w.get("buckets")
+        if wb is not None and len(wb) == len(out.buckets):
+            out.buckets = [int(x) for x in wb]
         return out
 
 
@@ -315,4 +343,20 @@ def render_prometheus(snapshot: dict, prefix: str = "dmlc", labels: str = "") ->
         lines.append(f"{metric}_count{body} {int(count)}")
         if count and not math.isnan(mean):
             lines.append(f"{metric}_sum{body} {mean * count}")
+        # Sibling histogram family: exact cumulative bucket counts (lossless
+        # under cross-node aggregation, unlike quantiles). Emitted only when
+        # the buckets cover every observation — a legacy peer's snapshot
+        # without buckets must not render a histogram that contradicts its
+        # own _count.
+        buckets = s.get("buckets") or {}
+        total = buckets.get("+Inf", 0)
+        if total and total == int(count):
+            hist = _prom_name(prefix, name) + "_hist_seconds"
+            lines.append(f"# TYPE {hist} histogram")
+            for le, cum in buckets.items():
+                lelabel = f'le="{le}"'
+                lines.append(f"{hist}_bucket{qbody(lelabel)} {int(cum)}")
+            lines.append(f"{hist}_count{body} {total}")
+            if not math.isnan(mean):
+                lines.append(f"{hist}_sum{body} {mean * count}")
     return "\n".join(lines) + ("\n" if lines else "")

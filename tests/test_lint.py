@@ -758,6 +758,82 @@ def test_o1_traced_methods_is_idempotent_and_spans_fire():
 
 
 # ---------------------------------------------------------------------------
+# O2 — profile-reading decision paths must stamp the flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_o2_fires_on_unstamped_profile_read_in_class():
+    src = """
+    class Rebalancer:
+        def pick(self, members):
+            costs = {m: self.profiler.mean_cost(m) for m in members}
+            return min(costs, key=costs.get)
+    """
+    assert fired(src, "dmlc_tpu/scheduler/x.py") == ["O2"]
+
+
+def test_o2_fires_on_unstamped_module_function():
+    src = """
+    def hot(profiler, model, bound):
+        return profiler.frac_over(bound, model=model) > 0.1
+    """
+    assert fired(src, "dmlc_tpu/scheduler/x.py") == ["O2"]
+
+
+def test_o2_silent_when_some_method_stamps_flight():
+    # Class granularity: the read and the stamp legitimately live in
+    # different methods of one decision-maker.
+    src = """
+    class Rebalancer:
+        def pick(self, members):
+            return min(members, key=lambda m: self.profiler.mean_cost(m))
+
+        def apply(self, plan):
+            self.flight.note("placement_decision", moves=plan.moves)
+    """
+    assert fired(src, "dmlc_tpu/scheduler/x.py") == []
+
+
+def test_o2_silent_on_advise_consumer_that_stamps():
+    src = """
+    class Scheduler:
+        def assign(self, jobs, members):
+            plan = self.advisor.advise(jobs, members)
+            if plan is not None:
+                self.flight.note("placement_apply", trigger=plan.trigger)
+            return plan
+    """
+    assert fired(src, "dmlc_tpu/scheduler/x.py") == []
+
+
+def test_o2_scope_and_exemptions():
+    src = """
+    class Reporter:
+        def table(self):
+            return self.profiler.percentile(99)  # reporting read: exempt
+
+        def status(self, profiler):
+            return {"p99": profiler.percentile(99)}
+    """
+    assert fired(src, "dmlc_tpu/scheduler/x.py") == []
+    # Outside scheduler/ (the CLI, observe.py, tests) reads report freely.
+    read = """
+    def show(profiler):
+        return profiler.mean_cost("m0")
+    """
+    assert fired(read, "dmlc_tpu/cluster/x.py") == []
+    assert fired(read, "tests/x.py") == []
+
+
+def test_o2_suppression_with_justification():
+    src = """
+    def probe(profiler):
+        return profiler.mean_cost("m0")  # dmlc-lint: disable=O2 -- read-only canary probe, decides nothing
+    """
+    assert fired(src, "dmlc_tpu/scheduler/x.py") == []
+
+
+# ---------------------------------------------------------------------------
 # the real tree + the CLI contract
 # ---------------------------------------------------------------------------
 
@@ -781,7 +857,8 @@ def test_cli_lists_all_rules_and_exits_nonzero_on_findings(tmp_path):
         cwd=REPO, capture_output=True, text=True, timeout=60,
     )
     assert r.returncode == 0
-    for rule_id in ("D1", "J1", "J2", "J3", "L1", "E1", "H1", "F1", "R1", "O1", "S1"):
+    for rule_id in ("D1", "J1", "J2", "J3", "L1", "E1", "H1", "F1", "R1", "O1",
+                    "O2", "S1"):
         assert rule_id in r.stdout
     bad = tmp_path / "dmlc_tpu" / "cluster"
     bad.mkdir(parents=True)

@@ -94,6 +94,47 @@ class TestRegistry:
     def test_empty_registry_renders_empty(self):
         assert Registry().prometheus_text() == ""
 
+    def test_prometheus_histogram_exposition(self):
+        r = Registry()
+        r.latency("rpc/sdfs.fetch").extend([0.1] * 10)
+        text = r.prometheus_text()
+        assert "# TYPE dmlc_rpc_sdfs_fetch_hist_seconds histogram" in text
+        assert 'dmlc_rpc_sdfs_fetch_hist_seconds_bucket{le="0.1"} 10' in text
+        assert 'dmlc_rpc_sdfs_fetch_hist_seconds_bucket{le="+Inf"} 10' in text
+        assert "dmlc_rpc_sdfs_fetch_hist_seconds_count 10" in text
+        assert "dmlc_rpc_sdfs_fetch_hist_seconds_sum" in text
+        # Cumulative: buckets below the value stay at 0.
+        assert 'dmlc_rpc_sdfs_fetch_hist_seconds_bucket{le="0.05"} 0' in text
+
+    def test_prometheus_histogram_with_node_label(self):
+        r = Registry()
+        r.latency("rpc/sdfs.fetch").extend([0.1] * 4)
+        text = render_prometheus(r.snapshot(), labels='node="10.0.0.1:8852"')
+        assert (
+            'dmlc_rpc_sdfs_fetch_hist_seconds_bucket'
+            '{node="10.0.0.1:8852",le="0.1"} 4' in text
+        )
+
+    def test_histogram_absent_for_legacy_wire(self):
+        """A pre-histogram peer's snapshot (no buckets) must not render a
+        hist family contradicting its own _count."""
+        r = Registry()
+        r.latency("x").extend([0.1] * 10)
+        snap = r.snapshot()
+        del snap["latency"]["x"]["buckets"]
+        text = render_prometheus(snap)
+        assert "_hist_seconds" not in text
+        assert "dmlc_x_seconds_count 10" in text
+
+    def test_histogram_buckets_merge_and_roundtrip(self):
+        a = LatencyStats([0.01] * 4)
+        b = LatencyStats([1.0] * 6)
+        a.merge(LatencyStats.from_wire(b.to_wire()))
+        buckets = a.summary()["buckets"]
+        assert buckets["0.01"] == 4
+        assert buckets["1.0"] == 10
+        assert buckets["+Inf"] == 10
+
 
 # ---------------------------------------------------------------------------
 # LatencyStats.merge: weighted reservoir regression
@@ -332,3 +373,70 @@ class TestFlightRecorder:
         wire = net.client("c").call("n1", "obs.flight", {}, timeout=5.0)
         assert wire["events"][0]["kind"] == "gray_demote"
         assert wire["node"] == "n1"
+
+
+# ---------------------------------------------------------------------------
+# Fleet trace merge: per-node skew accounting + the clamp alert
+# ---------------------------------------------------------------------------
+
+
+class TestTraceSkew:
+    @staticmethod
+    def _node(events, offset=0.0, rtt=0.001):
+        return {"dump": {"events": events, "dropped": 0},
+                "offset": offset, "rtt": rtt}
+
+    PARENT = {"name": "rpc/job.predict", "start": 1.0, "dur": 0.5,
+              "span": "s1", "trace": "t1"}
+
+    def _child(self, start: float) -> dict:
+        return {"name": "device/forward", "start": start, "dur": 0.1,
+                "span": "s2", "parent": "s1", "trace": "t1"}
+
+    def test_clamp_skew_measured_per_node_and_alerted(self):
+        from dmlc_tpu.cluster.observe import merge_fleet_trace
+
+        fr = FlightRecorder(clock=FakeClock())
+        doc = merge_fleet_trace(
+            {"a": self._node([self.PARENT]),
+             "b": self._node([self._child(0.9)])},
+            flight=fr, skew_alert_s=0.05,
+        )
+        nodes = doc["otherData"]["nodes"]
+        assert nodes["b"]["max_skew_s"] == pytest.approx(0.1)
+        assert nodes["a"]["max_skew_s"] == 0.0
+        assert doc["otherData"]["skew_clamped_children"] == 1
+        # The child renders AT its parent's start, never before it.
+        rendered = [e for e in doc["traceEvents"]
+                    if e.get("ph") == "X" and e["name"] == "device/forward"]
+        assert rendered[0]["ts"] == pytest.approx(1.0 * 1e6)
+        alerts = [e for e in fr.events() if e["kind"] == "trace_skew_clamp"]
+        assert len(alerts) == 1
+        assert alerts[0]["node"] == "b"
+        assert alerts[0]["max_skew_s"] == pytest.approx(0.1)
+        assert alerts[0]["clamped"] == 1
+        assert alerts[0]["threshold_s"] == 0.05
+
+    def test_sub_threshold_skew_clamps_quietly(self):
+        from dmlc_tpu.cluster.observe import merge_fleet_trace
+
+        fr = FlightRecorder(clock=FakeClock())
+        doc = merge_fleet_trace(
+            {"a": self._node([self.PARENT]),
+             "b": self._node([self._child(0.99)])},
+            flight=fr, skew_alert_s=0.05,
+        )
+        # Clamped (causality must still render forward) but under the
+        # alert line: no flight noise for sub-RTT jitter.
+        assert doc["otherData"]["skew_clamped_children"] == 1
+        assert doc["otherData"]["nodes"]["b"]["max_skew_s"] == pytest.approx(0.01)
+        assert not [e for e in fr.events() if e["kind"] == "trace_skew_clamp"]
+
+    def test_merge_without_flight_still_reports_skew(self):
+        from dmlc_tpu.cluster.observe import merge_fleet_trace
+
+        doc = merge_fleet_trace(
+            {"a": self._node([self.PARENT]),
+             "b": self._node([self._child(0.8)])},
+        )
+        assert doc["otherData"]["nodes"]["b"]["max_skew_s"] == pytest.approx(0.2)

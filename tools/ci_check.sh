@@ -23,8 +23,9 @@
 #   8. chaos matrix     — the seeded fault-injection suites (crashes,
 #                         partitions, failover, disk bit-rot/torn writes,
 #                         overload: deadlines/shedding/breakers/gray
-#                         ejection, and the generation join/leave soak
-#                         with exactly-once token delivery) across a
+#                         ejection, the generation join/leave soak with
+#                         exactly-once token delivery, and the placement
+#                         soak: SLO burn -> profile-driven replan) across a
 #                         3-seed-base matrix: each leg offsets every
 #                         parametrized seed range into a disjoint region
 #                         of the fault space (DMLC_CHAOS_SEED)
@@ -101,16 +102,16 @@ else
   fail=1
 fi
 
-note "chaos suite (3-seed matrix: crashes/partitions/failover x disk faults x overload x generation soak)"
+note "chaos suite (3-seed matrix: crashes/partitions/failover x disk faults x overload x generation soak x placement soak)"
 for seed_base in 0 1000 2000; do
   note "chaos matrix leg DMLC_CHAOS_SEED=$seed_base"
   if env JAX_PLATFORMS=cpu DMLC_CHAOS_SEED="$seed_base" python -m pytest \
       tests/test_chaos.py tests/test_sdfs_faults.py tests/test_overload.py \
-      tests/test_generate_cluster.py \
+      tests/test_generate_cluster.py tests/test_placement.py \
       -q -p no:cacheprovider; then
     note "chaos leg $seed_base OK"
   else
-    note "chaos leg $seed_base FAILED (replay: DMLC_CHAOS_SEED=$seed_base pytest tests/test_chaos.py tests/test_sdfs_faults.py tests/test_overload.py tests/test_generate_cluster.py)"
+    note "chaos leg $seed_base FAILED (replay: DMLC_CHAOS_SEED=$seed_base pytest tests/test_chaos.py tests/test_sdfs_faults.py tests/test_overload.py tests/test_generate_cluster.py tests/test_placement.py)"
     fail=1
   fi
 done

@@ -56,6 +56,7 @@ class ImportMap:
 
 
 from tools.lint.rules import (  # noqa: E402
+    decisions,
     excepts,
     hotpath,
     jit,
@@ -77,4 +78,5 @@ RULES = [
     persistence.F1,
     rpctimeout.R1,
     rpcspan.O1,
+    decisions.O2,
 ]

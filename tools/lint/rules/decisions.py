@@ -1,0 +1,104 @@
+"""O2 — profile-reading scheduler decisions must stamp the flight recorder.
+
+The placement loop's contract (docs/OBSERVABILITY.md §5) is that every
+scheduling decision derived from cost profiles is reconstructible from the
+flight recorder: a plan that silently re-pointed dispatch traffic is
+indistinguishable, in a postmortem, from the gray failure it was reacting
+to. ``scheduler/placement.py`` stamps ``placement_decision`` /
+``placement_throttled`` / ``slo_*`` events today; this rule keeps the NEXT
+decision path honest.
+
+Structurally: inside ``dmlc_tpu/scheduler/``, code that *reads* the profile
+surface — calling ``.advise(...)``, ``.mean_cost(...)`` or
+``.frac_over(...)`` — is a decision input. A class with any such read must
+have some method that records a flight event (a ``.note(...)`` call on a
+receiver whose dotted path mentions ``flight``); a module-level function
+with a read must contain one itself. Class granularity, not per-method:
+the read and the stamp legitimately live in different methods of one
+decision-maker (JobScheduler reads in ``_assign_from_plan``, stamps there
+too, but the evaluator reads in ``_burn`` and stamps in ``evaluate``).
+
+Percentile reads are exempt: ``percentile`` also serves pure reporting
+(status verbs, CLI tables), which must not be forced to stamp events.
+
+A read that genuinely decides nothing uses the standard suppression:
+``# dmlc-lint: disable=O2 -- why``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Finding
+from tools.lint.rules import dotted_name
+
+READ_METHODS = {"advise", "mean_cost", "frac_over"}
+
+
+def _profile_reads(node: ast.AST) -> list[ast.Call]:
+    out = []
+    for inner in ast.walk(node):
+        if (
+            isinstance(inner, ast.Call)
+            and isinstance(inner.func, ast.Attribute)
+            and inner.func.attr in READ_METHODS
+        ):
+            out.append(inner)
+    return out
+
+
+def _stamps_flight(node: ast.AST) -> bool:
+    for inner in ast.walk(node):
+        if (
+            isinstance(inner, ast.Call)
+            and isinstance(inner.func, ast.Attribute)
+            and inner.func.attr == "note"
+        ):
+            recv = dotted_name(inner.func.value)
+            if recv is not None and "flight" in recv.lower():
+                return True
+    return False
+
+
+class _O2:
+    id = "O2"
+    summary = "profile-read decision path without a flight-recorder stamp"
+    hint = ("a scheduler path that reads cost profiles (advise/mean_cost/"
+            "frac_over) is making placement-relevant decisions: record them "
+            "with flight.note(...) somewhere in the same class (or function),"
+            " or justify with '# dmlc-lint: disable=O2 -- why'")
+    scope_doc = "dmlc_tpu/scheduler/"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("dmlc_tpu/scheduler/")
+
+    def check(self, tree: ast.AST, relpath: str) -> list[Finding]:
+        findings: list[Finding] = []
+        module_body: list[ast.stmt] = getattr(tree, "body", [])
+        for node in module_body:
+            if isinstance(node, ast.ClassDef):
+                reads = _profile_reads(node)
+                if reads and not _stamps_flight(node):
+                    first = reads[0]
+                    findings.append(Finding(
+                        relpath, first.lineno, first.col_offset, self.id,
+                        f"class {node.name} reads cost profiles "
+                        f"(.{first.func.attr}(...)) but no method records a "
+                        "flight event — placement decisions must be "
+                        "reconstructible from the flight recorder",
+                    ))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                reads = _profile_reads(node)
+                if reads and not _stamps_flight(node):
+                    first = reads[0]
+                    findings.append(Finding(
+                        relpath, first.lineno, first.col_offset, self.id,
+                        f"function {node.name} reads cost profiles "
+                        f"(.{first.func.attr}(...)) without recording a "
+                        "flight event — stamp the decision with "
+                        "flight.note(...)",
+                    ))
+        return findings
+
+
+O2 = _O2()

@@ -76,9 +76,29 @@ def main() -> int:
         observe.export_fleet_trace(
             leader.rpc, sorted(leader.active_member_addrs()), out
         )
+        # Live cost profiles (docs/OBSERVABILITY.md §5): the completed
+        # workload must have grown dispatch lanes for >= 2 members in the
+        # leader's profiler, served over the obs.profile verb.
+        profile = leader.rpc.call(
+            leader.self_member_addr, "obs.profile", {}, timeout=5.0
+        )
+        profile_members = {
+            member
+            for lanes in profile.get("profiles", {}).values()
+            for member in lanes
+        }
     finally:
         tracing.disable()
         stop_local_cluster(nodes)
+
+    if len(profile_members) < 2:
+        print(
+            "trace smoke FAILED: obs.profile grew lanes for "
+            f"{sorted(profile_members)} (need >= 2 members); the dispatch "
+            "path is not feeding the cost profiler",
+            file=sys.stderr,
+        )
+        return 1
 
     doc = json.loads(out.read_text())  # must load as Perfetto JSON
     events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
@@ -130,7 +150,8 @@ def main() -> int:
     print(
         f"trace smoke OK: {len(events)} spans, {len(by_trace)} traces, "
         f"{len(multi_node)} crossing >= 2 nodes, "
-        f"{len(gen_steps)} parented gen/step span(s)"
+        f"{len(gen_steps)} parented gen/step span(s), "
+        f"profile lanes for {len(profile_members)} members"
     )
     return 0
 
