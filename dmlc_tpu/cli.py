@@ -44,6 +44,22 @@ def format_latency(summary: dict[str, float]) -> str:
     )
 
 
+def pop_option(args: list[str], name: str, cast=str):
+    """Extract ``--name value`` from a REPL token list (mutates ``args``);
+    None when absent, ValueError on a missing or uncastable value."""
+    if name not in args:
+        return None
+    i = args.index(name)
+    if i + 1 >= len(args):
+        raise ValueError(f"{name} needs a value")
+    try:
+        value = cast(args[i + 1])
+    except (TypeError, ValueError):
+        raise ValueError(f"{name} got a bad value {args[i + 1]!r}") from None
+    del args[i:i + 2]
+    return value
+
+
 HELP = """\
 Commands (reference: README.md:10-23):
   list_mem | lm                         list active members
@@ -77,7 +93,9 @@ Commands (reference: README.md:10-23):
   metrics [prom|fleet]                  this node's metric registry (counters,
                                         gauges, latency summaries); `prom` =
                                         Prometheus text; `fleet` = the leader's
-                                        latest per-member scrape
+                                        latest per-member scrape + tree-merged
+                                        totals (flags: --top K busiest nodes,
+                                        --worst K most error-laden nodes)
   trace on|off|summary|export <path>    span tracing: toggle FLEET-WIDE,
                                         aggregate table, local Chrome trace
   trace fleet <path>                    merged fleet trace: every node's spans,
@@ -87,6 +105,8 @@ Commands (reference: README.md:10-23):
   profile [member]                      live cost-profile lanes (model x
                                         member x stage: n/mean/p50/p99/qps);
                                         the leader's holds the whole fleet
+                                        (flags: --model M, --top K busiest
+                                        lanes, --worst K slowest-p99 lanes)
   slo                                   per-model SLO burn rates + the current
                                         placement plan (leader's evaluator)
   help                                  this text
@@ -337,23 +357,70 @@ class Cli:
             if sub == "prom":
                 return n.registry.prometheus_text() or "(no metrics yet)"
             if sub == "fleet":
+                opts = list(args[1:])
                 try:
-                    fleet = n.rpc.call(
+                    top = pop_option(opts, "--top", int)
+                    worst = pop_option(opts, "--worst", int)
+                except ValueError as e:
+                    return str(e)
+                if opts:
+                    return "usage: metrics fleet [--top K] [--worst K]"
+                try:
+                    reply = n.rpc.call(
                         n.tracker.current, "obs.fleet", {}, timeout=5.0
-                    )["fleet"]
+                    )
                 except Exception as e:
                     return f"leader fleet scrape unavailable: {e}"
+                fleet = reply.get("fleet") or {}
                 if not fleet:
                     return "no fleet scrape yet (leader scrapes on the probe cadence)"
+
+                # Error-shaped counters rank "worst"; total counter movement
+                # ranks "top" (the busiest nodes).
+                bad_keys = ("shed", "deadline_exceeded", "evicted",
+                            "breaker_open", "scrape_timeouts", "errors")
+
+                def activity(counters: dict) -> int:
+                    return sum(
+                        int(v or 0) for k, v in counters.items()
+                        if not k.endswith("_high")
+                    )
+
+                def badness(counters: dict) -> int:
+                    return sum(int(counters.get(k) or 0) for k in bad_keys)
+
+                entries = [
+                    (addr, (r.get("metrics") or {}).get("counters") or {})
+                    for addr, r in sorted(fleet.items())
+                ]
+                if worst is not None:
+                    entries.sort(key=lambda e: (-badness(e[1]), e[0]))
+                    entries = entries[:worst]
+                elif top is not None:
+                    entries.sort(key=lambda e: (-activity(e[1]), e[0]))
+                    entries = entries[:top]
                 rows = []
-                for addr, reply in sorted(fleet.items()):
-                    counters = (reply.get("metrics") or {}).get("counters") or {}
+                for addr, counters in entries:
                     nonzero = {k: v for k, v in sorted(counters.items()) if v}
                     rows.append([
                         addr,
                         ", ".join(f"{k}={v}" for k, v in nonzero.items()) or "(all zero)",
                     ])
-                return format_table(["node", "counters"], rows)
+                out = format_table(["node", "counters"], rows)
+                merged = (reply.get("merged") or {}).get("counters") or {}
+                if merged:
+                    totals = ", ".join(
+                        f"{k}={v}" for k, v in sorted(merged.items())
+                        if v and not k.endswith("_high")
+                    )
+                    out += f"\nfleet totals (tree-merged): {totals or '(all zero)'}"
+                stale = reply.get("stale") or []
+                if stale:
+                    out += (
+                        f"\nWARNING: {len(stale)} member(s) in STALE scrape "
+                        f"spans (delegates dark): {', '.join(stale)}"
+                    )
+                return out
             if sub == "show":
                 snap = n.registry.snapshot()
                 out = []
@@ -447,22 +514,46 @@ class Cli:
         if cmd == "profile":
             # Local snapshot by default (any node keeps one — the leader's
             # holds the fleet's lanes); `profile <member>` asks a peer.
-            if args:
-                snap = n.rpc.call(args[0], "obs.profile", {}, timeout=5.0)
+            opts = list(args)
+            try:
+                top = pop_option(opts, "--top", int)
+                worst = pop_option(opts, "--worst", int)
+                model_filter = pop_option(opts, "--model")
+            except ValueError as e:
+                return str(e)
+            if len(opts) > 1:
+                return "usage: profile [member] [--model M] [--top K] [--worst K]"
+            if opts:
+                snap = n.rpc.call(opts[0], "obs.profile", {}, timeout=5.0)
             else:
                 snap = n.profiler.snapshot()
-            rows = []
+            lanes = []
             for model, members in sorted(snap.get("profiles", {}).items()):
+                if model_filter is not None and model != model_filter:
+                    continue
                 for member, stages in sorted(members.items()):
                     for stage, s in sorted(stages.items()):
-                        rows.append([
-                            model, member, stage, s["n"],
-                            f"{s['mean'] * 1e3:.2f}ms",
-                            f"{s['p50'] * 1e3:.2f}ms",
-                            f"{s['p99'] * 1e3:.2f}ms",
-                            f"{s['qps']:.2f}",
-                        ])
+                        lanes.append((model, member, stage, s))
+            # --worst surfaces the slowest lanes (p99); --top the busiest.
+            if worst is not None:
+                lanes.sort(key=lambda x: (-float(x[3]["p99"]), x[0], x[1], x[2]))
+                lanes = lanes[:worst]
+            elif top is not None:
+                lanes.sort(key=lambda x: (-int(x[3]["n"]), x[0], x[1], x[2]))
+                lanes = lanes[:top]
+            rows = [
+                [
+                    model, member, stage, s["n"],
+                    f"{s['mean'] * 1e3:.2f}ms",
+                    f"{s['p50'] * 1e3:.2f}ms",
+                    f"{s['p99'] * 1e3:.2f}ms",
+                    f"{s['qps']:.2f}",
+                ]
+                for model, member, stage, s in lanes
+            ]
             if not rows:
+                if model_filter is not None:
+                    return f"no profile lanes for model {model_filter!r}"
                 return "no profile lanes yet (profiles grow from dispatches and scrapes)"
             return format_table(
                 ["model", "member", "stage", "n", "mean", "p50", "p99", "qps"], rows

@@ -39,6 +39,7 @@ from dmlc_tpu.cluster.observe import ObsService
 from dmlc_tpu.cluster.profile import CostProfiler
 from dmlc_tpu.cluster.retrypolicy import RetryPolicy
 from dmlc_tpu.cluster.rpc import TcpRpc, TcpRpcServer
+from dmlc_tpu.cluster.scrapetree import ScrapeDelegate, ScrapeTreeCoordinator
 from dmlc_tpu.cluster.sdfs import MemberStore, SdfsClient, SdfsLeader, SdfsMember
 from dmlc_tpu.cluster.transport import UdpTransport
 from dmlc_tpu.scheduler.jobs import JobScheduler
@@ -143,8 +144,34 @@ class ClusterNode:
         self.registry.gauge("predict_gate_active", lambda: self.predict_gate.active)
         self.registry.gauge("transfer_gate_active", lambda: self.transfer_gate.active)
         # Latest obs.metrics reply per member, scraped by the leader on the
-        # probe cadence (empty on non-leading nodes).
+        # probe cadence (empty on non-leading nodes). fleet_merged is the
+        # counter-exact fleet-wide rollup the scrape tree folds;
+        # fleet_stale lists member addrs whose span went dark this cycle.
         self.fleet_metrics: dict[str, dict] = {}
+        self.fleet_merged: dict = {}
+        self.fleet_stale: list[str] = []
+        # Head-based trace sampling (utils/tracing, docs/OBSERVABILITY.md
+        # §7): base rate + spans/s budget from config; the per-node
+        # decision counters ride obs.metrics as gauges so the adaptive
+        # controller's behavior is observable fleet-wide. The tracer is
+        # process-global — co-hosted nodes (localcluster) share one
+        # controller, exactly like they share one span buffer.
+        tracing.tracer.set_sampling(
+            rate=config.trace_sample_rate,
+            spans_per_s=config.trace_spans_per_s_budget,
+        )
+        self.registry.gauge(
+            "trace_sampled",
+            lambda: tracing.tracer.sampling_summary()["sampled"],
+        )
+        self.registry.gauge(
+            "trace_unsampled",
+            lambda: tracing.tracer.sampling_summary()["unsampled"],
+        )
+        self.registry.gauge(
+            "trace_sampling_rate",
+            lambda: tracing.tracer.sampling_summary()["effective_rate"],
+        )
         # Live cost profiles (cluster/profile.py): every node keeps one —
         # members feed their own gen/step lane, the leader additionally
         # folds dispatch latencies + fleet scrapes into fleet-wide lanes.
@@ -239,12 +266,22 @@ class ClusterNode:
             self.registry, flight=self.flight, lane=self.lane,
             profiler=self.profiler,
         )
+        # Scrape-tree delegate surface (cluster/scrapetree.py): ANY member
+        # can scrape a ring span on the leader's behalf — delegates are
+        # picked per cycle, so there is nothing to elect.
+        self.scrape_delegate = ScrapeDelegate(
+            self.rpc,
+            timeout_s=config.scrape_timeout_s,
+            concurrency=config.scrape_concurrency,
+            metrics=self.metrics,
+        )
         methods = traced_methods({
             **self.sdfs_member.methods(),
             **self.worker.methods(),
             **(self.generate_worker.methods() if self.generate_worker else {}),
             **self.model_loader.methods(),
             **self.obs.methods(),
+            **self.scrape_delegate.methods(),
             "node.info": self._node_info,
             "node.status": lambda p: self.status(remote=False),
         })
@@ -275,6 +312,7 @@ class ClusterNode:
         self.mesh_bootstrap = None
         self.advisor = None
         self.slo = None
+        self.scrapetree = None
         if self.is_candidate:
             self._start_leader_services()
 
@@ -405,14 +443,31 @@ class ClusterNode:
                     f"slo_fast_burn:{model}"
                 ),
             )
+        # Delegated scrape tree (cluster/scrapetree.py): past
+        # scrape_tree_min_members the scrape loop partitions the ring and
+        # folds delegate partials instead of calling every member itself.
+        self.scrapetree = ScrapeTreeCoordinator(
+            self.rpc,
+            clock=self.clock.monotonic,
+            span_size=self.config.scrape_span_size,
+            timeout_s=self.config.scrape_timeout_s,
+            concurrency=self.config.scrape_concurrency,
+            metrics=self.metrics,
+            flight=self.flight,
+        )
         methods = {
             **self.sdfs_leader.methods(),
             **self.scheduler.methods(),
             # Fleet-wide observability read-outs: the latest obs.metrics
             # snapshot per member (scraped by _obs_scrape_loop while
-            # leading), raw and as Prometheus text.
+            # leading), raw and as Prometheus text, plus the tree-merged
+            # fleet rollup and any spans dark this cycle.
             **traced_methods({
-                "obs.fleet": lambda p: {"fleet": dict(self.fleet_metrics)},
+                "obs.fleet": lambda p: {
+                    "fleet": dict(self.fleet_metrics),
+                    "merged": dict(self.fleet_merged),
+                    "stale": list(self.fleet_stale),
+                },
                 "obs.fleet_prom": lambda p: {
                     "text": observe.render_fleet_prometheus(dict(self.fleet_metrics))
                 },
@@ -727,22 +782,55 @@ class ClusterNode:
 
     def _obs_scrape_loop(self):
         """Leader-side fleet metrics scrape (docs/OBSERVABILITY.md): while
-        leading, pull every active member's ``obs.metrics`` on the probe
-        cadence and keep the latest reply — ``obs.fleet``/``obs.fleet_prom``
-        and the CLI ``metrics fleet`` verb read from here. Each pass also
-        closes the profile loop: scrapes fold into the leader's cost
-        profiler, the SLO evaluator re-judges the burn rates, and the
-        profile snapshot persists for warm-start."""
+        leading, refresh every active member's ``obs.metrics`` on the probe
+        cadence — directly (bounded concurrency, per-scrape deadlines) for
+        small fleets, through the delegated scrape tree past
+        ``scrape_tree_min_members`` so leader work stays ~O(sqrt(N)).
+        ``obs.fleet``/``obs.fleet_prom`` and the CLI ``metrics fleet`` verb
+        read from here. Each pass also closes the profile loop: scrapes
+        fold into the leader's cost profiler, the SLO evaluator re-judges
+        the burn rates (a fast-burn edge forces fleet-wide trace sampling
+        when configured), and the profile snapshot persists for warm-start."""
 
         def body():
-            fleet = observe.scrape_fleet_metrics(
-                self.rpc, self.active_member_addrs(), timeout=2.0
-            )
+            cfg = self.config
+            addrs = self.active_member_addrs()
+            if (
+                self.scrapetree is not None
+                and cfg.scrape_tree_enabled
+                and len(addrs) >= cfg.scrape_tree_min_members
+            ):
+                result = self.scrapetree.scrape(addrs)
+                fleet = result.members
+                self.fleet_merged = result.merged_summary
+                self.fleet_stale = sorted(
+                    a for s in result.stale_spans for a in s["addrs"]
+                )
+            else:
+                fleet = observe.scrape_fleet_metrics(
+                    self.rpc, addrs, timeout=cfg.scrape_timeout_s,
+                    concurrency=cfg.scrape_concurrency, metrics=self.metrics,
+                )
+                self.fleet_stale = []
             self.fleet_metrics = fleet
             for addr, reply in fleet.items():
                 self.profiler.ingest_scrape(addr, reply)
             if self.slo is not None:
-                self.slo.evaluate()
+                state = self.slo.evaluate()
+                if cfg.trace_burn_force_sample_s > 0:
+                    burning = [m for m, st in sorted(state.items())
+                               if st.get("fast_alert")]
+                    if burning:
+                        # Burn-flagged traffic must leave whole traces, not
+                        # a head-sampling lottery: force-sample locally and
+                        # push the window to every member (best-effort).
+                        tracing.tracer.force_sampling(
+                            cfg.trace_burn_force_sample_s
+                        )
+                        observe.force_fleet_sampling(
+                            self.rpc, addrs, cfg.trace_burn_force_sample_s,
+                            timeout=cfg.scrape_timeout_s,
+                        )
             if self.config.profile_persist:
                 self.profiler.save(self.profile_path())
 

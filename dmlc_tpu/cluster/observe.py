@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import logging
+from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 
 from dmlc_tpu.cluster.rpc import Rpc, RpcError, RpcUnreachable
@@ -64,7 +65,16 @@ class ObsService:
         })
 
     def _metrics(self, p: dict) -> dict:
-        return {"metrics": self.registry.snapshot(), "spans": tracer.summary()}
+        # ``mergeable`` (scrape-tree delegates set it) swaps the latency
+        # section to the exact-merge wire form so span partials fold
+        # counter-exactly; the sampling block makes the adaptive trace
+        # controller's behavior observable fleet-wide.
+        mergeable = bool(p.get("mergeable"))
+        return {
+            "metrics": self.registry.snapshot(mergeable=mergeable),
+            "spans": tracer.summary(),
+            "sampling": tracer.sampling_summary(),
+        }
 
     def _clock(self, p: dict) -> dict:
         # The tracer's own clock — the timebase every span timestamp lives
@@ -84,7 +94,13 @@ class ObsService:
             tracer.reset()
         if "enable" in p:
             tracer.enabled = bool(p["enable"])
-        return {"enabled": tracer.enabled}
+        if "sample_rate" in p or "spans_per_s" in p:
+            tracer.set_sampling(
+                rate=p.get("sample_rate"), spans_per_s=p.get("spans_per_s")
+            )
+        if p.get("force_sample_s"):
+            tracer.force_sampling(float(p["force_sample_s"]))
+        return {"enabled": tracer.enabled, "sampling": tracer.sampling_summary()}
 
     def _flight(self, p: dict) -> dict:
         if self.flight is None:
@@ -122,117 +138,160 @@ def measure_clock_offset(
     return best[1], best[0]
 
 
-def collect_fleet_trace(
-    rpc: Rpc, addrs: list[str], timeout: float = 10.0, clock_samples: int = 5,
-    flight=None, skew_alert_s: float = 0.0,
-) -> dict:
-    """Pull every node's span dump + clock offset and merge them into one
-    Chrome/Perfetto trace document. Unreachable nodes are skipped (named in
-    ``otherData.unreachable``) — a partial fleet trace beats none."""
-    per_node: dict[str, dict] = {}
-    unreachable: dict[str, str] = {}
-    for addr in addrs:
-        try:
-            offset, rtt = measure_clock_offset(
-                rpc, addr, local_now=tracer.now, samples=clock_samples,
-                timeout=timeout,
-            )
-            dump = rpc.call(addr, "obs.trace_dump", {}, timeout=timeout)
-            per_node[addr] = {"dump": dump, "offset": offset, "rtt": rtt}
-        except (RpcUnreachable, RpcError) as e:
-            unreachable[addr] = str(e)
-            log.warning("fleet trace: %s unreachable: %s", addr, e)
-    return merge_fleet_trace(
-        per_node, unreachable=unreachable, flight=flight,
-        skew_alert_s=skew_alert_s,
-    )
+class FleetTraceMerger:
+    """INCREMENTAL fleet-trace merge: ``add_node`` folds one node's dump
+    into the document under construction and the dump is released before
+    the next node is pulled — the collector holds one node's raw buffer at
+    a time instead of the whole fleet's (the O(N x max_events) memory
+    cliff at hundreds of members).
 
-
-def merge_fleet_trace(
-    per_node: dict, unreachable: dict | None = None, flight=None,
-    skew_alert_s: float = 0.0,
-) -> dict:
-    """Merge per-node dumps (``{addr: {"dump": obs.trace_dump reply,
-    "offset": s, "rtt": s}}``) into one trace-event document: one pid per
-    node (process_name metadata = its address), every timestamp translated
-    into the collector's timebase (``local = remote - offset``), and child
-    spans clamped to start no earlier than their parent — the residual
-    skew after alignment is sub-RTT, and a child rendered before its parent
-    would read as causality violated when it is only clock noise.
+    Semantics match the one-shot merge exactly: one pid lane per node
+    (process_name metadata = its address), every timestamp translated into
+    the collector's timebase (``local = remote - offset``), and child
+    spans clamped at ``finish()`` to start no earlier than their parent —
+    clamping must wait until every node reported, because a parent span
+    can arrive after its children (cross-node edges point backwards in
+    collection order). Only (index, parent, start) stubs are buffered for
+    that pass, never raw dumps.
 
     Clamping is corrective, so its MAGNITUDE is the health signal: each
     node's worst clamp distance lands in ``otherData.nodes[addr]
     .max_skew_s``, and any node past ``skew_alert_s`` (when > 0) records a
     ``trace_skew_clamp`` flight event — clock-alignment decay must be
     visible before it quietly corrupts every profile built on the spans."""
-    events: list[dict] = []
-    meta: list[dict] = []
-    dropped_total = 0
-    span_start: dict[str, float] = {}  # span_id -> aligned start (seconds)
-    parsed: list[tuple[int, dict, float]] = []
-    addr_of: dict[int, str] = {}
-    for pid, (addr, entry) in enumerate(sorted(per_node.items())):
-        offset = float(entry.get("offset", 0.0))
-        dump = entry["dump"]
-        dropped_total += int(dump.get("dropped", 0))
-        addr_of[pid] = addr
-        meta.append({
+
+    def __init__(self, flight=None, skew_alert_s: float = 0.0):
+        self.flight = flight
+        self.skew_alert_s = skew_alert_s
+        self._meta: list[dict] = []
+        self._events: list[dict] = []
+        self._span_start: dict[str, float] = {}  # span_id -> aligned start
+        # (event index, addr, parent span id, aligned start) — the clamp
+        # pass's working set, one small tuple per child span.
+        self._deferred: list[tuple[int, str, str, float]] = []
+        self._nodes: dict[str, dict] = {}
+        self._unreachable: dict[str, str] = {}
+        self._dropped = 0
+
+    def add_node(self, addr: str, dump: dict, offset=None, rtt=None) -> None:
+        """Fold one ``obs.trace_dump`` reply in (timebase offset from
+        ``measure_clock_offset``). The reply is not retained."""
+        pid = len(self._meta)
+        self._meta.append({
             "name": "process_name", "ph": "M", "pid": pid,
             "args": {"name": addr},
         })
+        self._nodes[addr] = {
+            "offset_s": offset, "rtt_s": rtt, "max_skew_s": 0.0, "clamped": 0,
+        }
+        self._dropped += int(dump.get("dropped", 0))
+        off = float(offset or 0.0)
         for e in dump.get("events", ()):
-            start = float(e["start"]) - offset
-            parsed.append((pid, e, start))
+            start = float(e["start"]) - off
             if e.get("span"):
                 # First writer wins: a span id is unique, but co-hosted
                 # nodes can both report an unlaned span.
-                span_start.setdefault(e["span"], start)
-    clamped = 0
-    max_skew: dict[str, float] = {addr: 0.0 for addr in per_node}
-    clamped_by: dict[str, int] = {addr: 0 for addr in per_node}
-    for pid, e, start in parsed:
-        parent = e.get("parent")
-        if parent is not None and parent in span_start:
-            floor = span_start[parent]
-            if start < floor:
-                addr = addr_of[pid]
-                max_skew[addr] = max(max_skew[addr], floor - start)
-                clamped_by[addr] += 1
-                start = floor
+                self._span_start.setdefault(e["span"], start)
+            args = dict(e.get("attrs") or {})
+            for key in ("trace", "span", "parent", "lane"):
+                if e.get(key) is not None:
+                    args[key] = e[key]
+            idx = len(self._events)
+            self._events.append({
+                "name": e["name"],
+                "ph": "X",
+                "ts": start * 1e6,
+                "dur": float(e["dur"]) * 1e6,
+                "pid": pid,
+                "tid": int(e.get("tid", 0)),
+                "args": args,
+            })
+            parent = e.get("parent")
+            if parent is not None:
+                self._deferred.append((idx, addr, parent, start))
+
+    def add_unreachable(self, addr: str, error) -> None:
+        self._unreachable[addr] = str(error)
+
+    def finish(self) -> dict:
+        """Run the deferred child-before-parent clamp pass and emit the
+        trace-event document."""
+        clamped = 0
+        for idx, addr, parent, start in self._deferred:
+            floor = self._span_start.get(parent)
+            if floor is not None and start < floor:
+                node = self._nodes[addr]
+                node["max_skew_s"] = max(node["max_skew_s"], floor - start)
+                node["clamped"] += 1
+                self._events[idx]["ts"] = floor * 1e6
                 clamped += 1
-        args = dict(e.get("attrs") or {})
-        for key in ("trace", "span", "parent", "lane"):
-            if e.get(key) is not None:
-                args[key] = e[key]
-        events.append({
-            "name": e["name"],
-            "ph": "X",
-            "ts": start * 1e6,
-            "dur": float(e["dur"]) * 1e6,
-            "pid": pid,
-            "tid": int(e.get("tid", 0)),
-            "args": args,
-        })
-    other: dict = {
-        "nodes": {a: {"offset_s": v.get("offset"), "rtt_s": v.get("rtt"),
-                      "max_skew_s": max_skew.get(a, 0.0)}
-                  for a, v in sorted(per_node.items())},
-        "skew_clamped_children": clamped,
-    }
-    if skew_alert_s > 0 and flight is not None:
-        for addr in sorted(max_skew):
-            if max_skew[addr] > skew_alert_s:
-                flight.note(
-                    "trace_skew_clamp", node=addr,
-                    max_skew_s=round(max_skew[addr], 6),
-                    clamped=clamped_by[addr], threshold_s=skew_alert_s,
-                )
-    if dropped_total:
-        other["dropped_events"] = dropped_total
-        other["note"] = "one or more nodes truncated their span buffer"
-    if unreachable:
-        other["unreachable"] = dict(unreachable)
-    return {"traceEvents": meta + events, "otherData": other}
+        other: dict = {
+            "nodes": {
+                a: {"offset_s": info["offset_s"], "rtt_s": info["rtt_s"],
+                    "max_skew_s": info["max_skew_s"]}
+                for a, info in sorted(self._nodes.items())
+            },
+            "skew_clamped_children": clamped,
+        }
+        if self.skew_alert_s > 0 and self.flight is not None:
+            for addr in sorted(self._nodes):
+                info = self._nodes[addr]
+                if info["max_skew_s"] > self.skew_alert_s:
+                    self.flight.note(
+                        "trace_skew_clamp", node=addr,
+                        max_skew_s=round(info["max_skew_s"], 6),
+                        clamped=info["clamped"], threshold_s=self.skew_alert_s,
+                    )
+        if self._dropped:
+            other["dropped_events"] = self._dropped
+            other["note"] = "one or more nodes truncated their span buffer"
+        if self._unreachable:
+            other["unreachable"] = dict(self._unreachable)
+        return {"traceEvents": self._meta + self._events, "otherData": other}
+
+
+def collect_fleet_trace(
+    rpc: Rpc, addrs: list[str], timeout: float = 10.0, clock_samples: int = 5,
+    flight=None, skew_alert_s: float = 0.0,
+) -> dict:
+    """Pull every node's span dump + clock offset and merge them into one
+    Chrome/Perfetto trace document, STREAMING node by node (each dump is
+    folded and released before the next is fetched). Unreachable nodes are
+    skipped (named in ``otherData.unreachable``) — a partial fleet trace
+    beats none."""
+    merger = FleetTraceMerger(flight=flight, skew_alert_s=skew_alert_s)
+    for addr in sorted(set(addrs)):
+        try:
+            offset, rtt = measure_clock_offset(
+                rpc, addr, local_now=tracer.now, samples=clock_samples,
+                timeout=timeout,
+            )
+            dump = rpc.call(addr, "obs.trace_dump", {}, timeout=timeout)
+            merger.add_node(addr, dump, offset=offset, rtt=rtt)
+        except (RpcUnreachable, RpcError) as e:
+            merger.add_unreachable(addr, e)
+            log.warning("fleet trace: %s unreachable: %s", addr, e)
+    return merger.finish()
+
+
+def merge_fleet_trace(
+    per_node: dict, unreachable: dict | None = None, flight=None,
+    skew_alert_s: float = 0.0,
+) -> dict:
+    """One-shot form of the merge: per-node dumps already in hand
+    (``{addr: {"dump": obs.trace_dump reply, "offset": s, "rtt": s}}``).
+    Thin wrapper over ``FleetTraceMerger`` so both paths share one
+    implementation; prefer ``collect_fleet_trace``/the merger directly at
+    fleet scale — this form holds every dump at once."""
+    merger = FleetTraceMerger(flight=flight, skew_alert_s=skew_alert_s)
+    for addr, entry in sorted(per_node.items()):
+        merger.add_node(
+            addr, entry["dump"], offset=entry.get("offset"),
+            rtt=entry.get("rtt"),
+        )
+    for addr, err in (unreachable or {}).items():
+        merger.add_unreachable(addr, err)
+    return merger.finish()
 
 
 def export_fleet_trace(
@@ -253,17 +312,20 @@ def export_fleet_trace(
 
 def set_fleet_tracing(
     rpc: Rpc, addrs: list[str], enable: bool, reset: bool = False,
-    timeout: float = 2.0,
+    timeout: float = 2.0, sample_rate: float | None = None,
+    spans_per_s: float | None = None,
 ) -> dict[str, bool]:
-    """Flip tracing on every reachable node (best-effort; returns
-    {addr: reached})."""
+    """Flip tracing on every reachable node, optionally pushing sampling
+    knobs in the same control frame (best-effort; returns {addr: reached})."""
+    payload: dict = {"enable": enable, "reset": reset}
+    if sample_rate is not None:
+        payload["sample_rate"] = float(sample_rate)
+    if spans_per_s is not None:
+        payload["spans_per_s"] = float(spans_per_s)
     out: dict[str, bool] = {}
     for addr in addrs:
         try:
-            rpc.call(
-                addr, "obs.trace_ctl", {"enable": enable, "reset": reset},
-                timeout=timeout,
-            )
+            rpc.call(addr, "obs.trace_ctl", dict(payload), timeout=timeout)
             out[addr] = True
         except (RpcUnreachable, RpcError) as e:
             out[addr] = False
@@ -271,18 +333,78 @@ def set_fleet_tracing(
     return out
 
 
-def scrape_fleet_metrics(
-    rpc: Rpc, addrs: list[str], timeout: float = 2.0
-) -> dict[str, dict]:
-    """One scrape pass: every reachable node's ``obs.metrics`` reply.
-    The leader runs this on the probe cadence (cluster/node.py) and keeps
-    the latest reply per member."""
-    out: dict[str, dict] = {}
+def force_fleet_sampling(
+    rpc: Rpc, addrs: list[str], seconds: float, timeout: float = 2.0
+) -> dict[str, bool]:
+    """Push a forced-sampling window to every reachable node — the
+    SLO-burn hook: while a model burns error budget the leader wants whole
+    traces from everyone, not a head-sampling lottery (best-effort)."""
+    out: dict[str, bool] = {}
     for addr in addrs:
         try:
-            out[addr] = rpc.call(addr, "obs.metrics", {}, timeout=timeout)
+            rpc.call(
+                addr, "obs.trace_ctl", {"force_sample_s": float(seconds)},
+                timeout=timeout,
+            )
+            out[addr] = True
         except (RpcUnreachable, RpcError) as e:
-            log.debug("metrics scrape %s failed: %s", addr, e)
+            out[addr] = False
+            log.warning("force_sampling %s failed: %s", addr, e)
+    return out
+
+
+def scrape_metrics_with_misses(
+    rpc: Rpc, addrs: list[str], timeout: float = 2.0, concurrency: int = 1,
+    metrics=None, mergeable: bool = False,
+) -> tuple[dict[str, dict], dict[str, str]]:
+    """One scrape pass returning ``(replies, misses)``. Each member scrape
+    carries its OWN deadline (``timeout``) and, with ``concurrency`` > 1,
+    runs on a small pool — one wedged member costs one slot for one
+    timeout instead of stalling everyone behind it serially. Failed
+    scrapes land in ``misses`` and count ``scrape_timeouts`` in
+    ``metrics``. ``mergeable`` requests the exact-merge latency form (what
+    scrape-tree delegates feed ``merge_mergeable_snapshots``)."""
+    payload = {"mergeable": True} if mergeable else {}
+
+    def one(addr: str):
+        try:
+            return rpc.call(addr, "obs.metrics", dict(payload), timeout=timeout), None
+        except (RpcUnreachable, RpcError) as e:
+            return None, str(e)
+
+    if concurrency > 1 and len(addrs) > 1:
+        with ThreadPoolExecutor(
+            max_workers=min(int(concurrency), len(addrs))
+        ) as pool:
+            results = list(pool.map(one, addrs))
+    else:
+        # Serial path: deterministic on the sim fabric (a thread pool over
+        # the virtual clock would interleave nondeterministically).
+        results = [one(a) for a in addrs]
+    out: dict[str, dict] = {}
+    misses: dict[str, str] = {}
+    for addr, (reply, err) in zip(addrs, results):
+        if reply is not None:
+            out[addr] = reply
+        else:
+            misses[addr] = err or "unreachable"
+            if metrics is not None:
+                metrics.inc("scrape_timeouts")
+            log.debug("metrics scrape %s failed: %s", addr, err)
+    return out, misses
+
+
+def scrape_fleet_metrics(
+    rpc: Rpc, addrs: list[str], timeout: float = 2.0, concurrency: int = 1,
+    metrics=None,
+) -> dict[str, dict]:
+    """One scrape pass: every reachable node's ``obs.metrics`` reply.
+    The leader runs this on the probe cadence (cluster/node.py) for small
+    fleets — past ``scrape_tree_min_members`` it delegates along the ring
+    instead (cluster/scrapetree.py). Keeps the latest reply per member."""
+    out, _ = scrape_metrics_with_misses(
+        rpc, addrs, timeout=timeout, concurrency=concurrency, metrics=metrics
+    )
     return out
 
 
@@ -299,12 +421,15 @@ def render_fleet_prometheus(fleet: dict[str, dict], prefix: str = "dmlc") -> str
 
 
 __all__ = [
+    "FleetTraceMerger",
     "ObsService",
     "collect_fleet_trace",
     "export_fleet_trace",
+    "force_fleet_sampling",
     "measure_clock_offset",
     "merge_fleet_trace",
     "render_fleet_prometheus",
     "scrape_fleet_metrics",
+    "scrape_metrics_with_misses",
     "set_fleet_tracing",
 ]

@@ -1,0 +1,671 @@
+"""Replayable load generation + SLO certification over the sim fabric.
+
+The observability plane (scrape trees, adaptive trace sampling, SLO burn
+rates) is only trustworthy if it can be DEMONSTRATED against known traffic
+— so this module replays a fully seeded workload through a simulated fleet
+on the virtual clock and emits a certification document
+(``slo_cert.json``, docs/OPERATIONS.md) any run with the same seed
+reproduces byte-for-byte in its integer fields:
+
+- **Open-loop arrivals** — an inhomogeneous Poisson process (Lewis-Shedler
+  thinning against the peak rate), so load does NOT back off when the
+  fleet slows down; that is what makes deadline misses and sheds honest.
+- **Traffic shape** — a base rate modulated by a diurnal sinusoid and
+  scripted flash crowds (start/duration/multiplier), mixing predict and
+  generate requests across models by weight.
+- **Simulated members** — each member admits through a token bucket on the
+  virtual clock (overflow -> ``Overloaded`` shed), serves with a seeded
+  jittered service time (a deterministic slow minority models stragglers,
+  and queue pressure inflates them further), raising ``DeadlineExceeded``
+  when the simulated service cannot fit the caller's remaining budget and
+  occasionally evicting generate requests under pressure.
+- **The real observability plane** — the leader scrapes through the real
+  ``ScrapeTreeCoordinator``/``ScrapeDelegate`` tree, folds profiles with
+  the real ``CostProfiler``/``SloEvaluator``, and the real tracer head-
+  samples requests — errors force-recorded — so the certificate measures
+  the plane this repo ships, not a mock of it.
+
+The certificate pins: per-model p50/p99 vs objective, SLO burn rates
+(read from the same ``SloEvaluator`` state the leader alerts on), shed /
+deadline / eviction counts, leader scrape-RPC cost vs the 4*sqrt(N)
+tree bound, sampling effectiveness, and that 100% of error and
+deadline-exceeded request traces survived into the merged fleet trace.
+``validate_slo_cert`` is the schema gate CI runs (tools/slo_cert.py).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from dmlc_tpu.cluster import observe, tracectx
+from dmlc_tpu.cluster.profile import CostProfiler
+from dmlc_tpu.cluster.rpc import (
+    DeadlineExceeded,
+    Overloaded,
+    RpcError,
+    RpcUnreachable,
+    SimRpcNetwork,
+)
+from dmlc_tpu.cluster.scrapetree import ScrapeDelegate, ScrapeTreeCoordinator
+from dmlc_tpu.scheduler.placement import SloEvaluator, SloObjective
+from dmlc_tpu.utils import tracing
+from dmlc_tpu.utils.metrics import Registry
+from dmlc_tpu.utils.tracing import traced_methods
+
+SLO_CERT_VERSION = 1
+
+# Per-request deadline budget by traffic kind (seconds of virtual time).
+KIND_DEADLINE_S = {"predict": 0.5, "generate": 2.0}
+
+# Mean simulated service time by kind; jittered per request, inflated on
+# the deterministic slow minority and again under admission pressure.
+KIND_SERVICE_S = {"predict": 0.08, "generate": 0.45}
+
+
+# ---------------------------------------------------------------------------
+# Traffic shape
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TrafficMix:
+    """One slice of the offered traffic: a model served by one kind of
+    request, drawn with probability proportional to ``weight``."""
+
+    model: str
+    kind: str  # "predict" | "generate"
+    weight: float = 1.0
+
+
+@dataclass(frozen=True)
+class FlashCrowd:
+    """A scripted step burst: rate multiplies by ``multiplier`` for
+    ``duration_s`` starting at ``start_s`` (overlapping crowds stack)."""
+
+    start_s: float
+    duration_s: float
+    multiplier: float
+
+    def factor_at(self, t: float) -> float:
+        return self.multiplier if self.start_s <= t < self.start_s + self.duration_s else 1.0
+
+
+@dataclass(frozen=True)
+class TrafficSpec:
+    """A fully seeded workload description — same spec, same arrivals."""
+
+    duration_s: float
+    base_rps: float
+    mixes: tuple[TrafficMix, ...]
+    diurnal_amplitude: float = 0.0   # 0..1: rate swings +-amplitude
+    diurnal_period_s: float = 86400.0
+    flash_crowds: tuple[FlashCrowd, ...] = ()
+    seed: int = 0
+
+    def rate_at(self, t: float) -> float:
+        """Instantaneous offered rate (requests/s of virtual time)."""
+        rate = self.base_rps
+        if self.diurnal_amplitude > 0.0:
+            rate *= 1.0 + self.diurnal_amplitude * math.sin(
+                2.0 * math.pi * t / self.diurnal_period_s
+            )
+        for crowd in self.flash_crowds:
+            rate *= crowd.factor_at(t)
+        return max(0.0, rate)
+
+    def peak_rate(self) -> float:
+        """An upper bound on ``rate_at`` — the thinning envelope. Assumes
+        the worst case of every crowd overlapping; a loose bound only
+        costs rejected candidates, never correctness."""
+        peak = self.base_rps * (1.0 + max(0.0, self.diurnal_amplitude))
+        for crowd in self.flash_crowds:
+            peak *= max(1.0, crowd.multiplier)
+        return max(peak, 1e-9)
+
+    def to_wire(self) -> dict:
+        return {
+            "duration_s": self.duration_s,
+            "base_rps": self.base_rps,
+            "seed": self.seed,
+            "diurnal_amplitude": self.diurnal_amplitude,
+            "diurnal_period_s": self.diurnal_period_s,
+            "mixes": [
+                {"model": m.model, "kind": m.kind, "weight": m.weight}
+                for m in self.mixes
+            ],
+            "flash_crowds": [
+                {"start_s": c.start_s, "duration_s": c.duration_s,
+                 "multiplier": c.multiplier}
+                for c in self.flash_crowds
+            ],
+        }
+
+
+class OpenLoopArrivals:
+    """Inhomogeneous Poisson arrivals by Lewis-Shedler thinning: candidate
+    gaps are exponential at the peak rate; each candidate survives with
+    probability ``rate_at(t) / peak``. Open-loop by construction — the
+    schedule never waits for the system under test."""
+
+    def __init__(self, spec: TrafficSpec):
+        self.spec = spec
+        self._rng = random.Random(spec.seed ^ 0xA11)
+        self._weights = [max(0.0, m.weight) for m in spec.mixes]
+        self._total_weight = sum(self._weights)
+        if self._total_weight <= 0:
+            raise ValueError("TrafficSpec.mixes must carry positive weight")
+
+    def _pick_mix(self) -> TrafficMix:
+        x = self._rng.random() * self._total_weight
+        for mix, w in zip(self.spec.mixes, self._weights):
+            x -= w
+            if x <= 0:
+                return mix
+        return self.spec.mixes[-1]
+
+    def __iter__(self) -> Iterator[tuple[float, TrafficMix]]:
+        lam = self.spec.peak_rate()
+        t = 0.0
+        while True:
+            t += self._rng.expovariate(lam)
+            if t >= self.spec.duration_s:
+                return
+            if self._rng.random() * lam <= self.spec.rate_at(t):
+                yield t, self._pick_mix()
+
+
+# ---------------------------------------------------------------------------
+# Simulated members
+# ---------------------------------------------------------------------------
+
+
+class SimMember:
+    """One simulated serving member: token-bucket admission on the virtual
+    clock, seeded jittered service times, deterministic stragglers, and
+    kv-pressure evictions for generate traffic. Serves the REAL
+    observability surface (ObsService + ScrapeDelegate) next to the fake
+    workload verbs, so scrapes and traces exercise production code."""
+
+    SLOW_EVERY = 7        # every 7th member is a straggler
+    SLOW_FACTOR = 4.0     # straggler service-time multiplier
+    PRESSURE_GAIN = 3.0   # service inflation at full admission pressure
+    EVICT_PRESSURE = 0.5   # generate evictions start above this utilization
+    EVICT_P = 0.25         # ... with this probability
+
+    def __init__(self, net: SimRpcNetwork, addr: str, index: int, *,
+                 seed: int, capacity_qps: float, scrape_timeout_s: float):
+        self.net = net
+        self.addr = addr
+        self.slow = (index % self.SLOW_EVERY) == self.SLOW_EVERY - 1
+        self.rng = random.Random((seed << 16) ^ (index * 0x9E37) ^ 0x51AB)
+        self.registry = Registry()
+        self.capacity_qps = max(1e-6, capacity_qps)
+        self.burst = max(2.0, self.capacity_qps)
+        self._tokens = self.burst
+        self._last_refill = net.clock()
+        self.obs = observe.ObsService(self.registry, lane=addr)
+        self.delegate = ScrapeDelegate(
+            net.client(addr), timeout_s=scrape_timeout_s, concurrency=1,
+            metrics=self.registry.counters,
+        )
+        net.serve(addr, self.methods())
+
+    def methods(self) -> dict:
+        table = traced_methods({
+            "job.predict": self._serve_request,
+            "job.generate": self._serve_request,
+        })
+        table.update(self.obs.methods())
+        table.update(self.delegate.methods())
+        return table
+
+    def _admit(self) -> float:
+        """Take one token or shed; returns utilization in [0, 1]."""
+        now = self.net.clock()
+        self._tokens = min(
+            self.burst, self._tokens + (now - self._last_refill) * self.capacity_qps
+        )
+        self._last_refill = now
+        utilization = 1.0 - self._tokens / self.burst
+        if self._tokens < 1.0:
+            self.registry.counters.inc("shed")
+            raise Overloaded(
+                f"{self.addr}: admission queue full", retry_after_s=0.1
+            )
+        self._tokens -= 1.0
+        return utilization
+
+    def _serve_request(self, p: dict) -> dict:
+        kind = str(p.get("kind") or "predict")
+        self.registry.counters.inc("requests")
+        utilization = self._admit()
+        service = KIND_SERVICE_S.get(kind, 0.1) * (0.5 + self.rng.random())
+        if self.slow:
+            service *= self.SLOW_FACTOR
+        service *= 1.0 + self.PRESSURE_GAIN * utilization
+        if (
+            kind == "generate"
+            and utilization > self.EVICT_PRESSURE
+            and self.rng.random() < self.EVICT_P
+        ):
+            self.registry.counters.inc("evicted")
+            raise RpcError(f"evicted: {self.addr} kv-cache pressure")
+        budget = float(p.get("deadline_s") or KIND_DEADLINE_S.get(kind, 1.0))
+        if service >= budget:
+            # The caller would wait out its whole budget; the sim raises
+            # the same verdict the deadline fabric would without dragging
+            # the shared virtual clock forward per straggler.
+            self.registry.counters.inc("deadline_exceeded")
+            raise DeadlineExceeded(
+                f"{self.addr}/{kind}: simulated service {service:.3f}s "
+                f"exceeds {budget:.3f}s budget"
+            )
+        self.registry.latency(f"rpc/job.{kind}").record(service)
+        return {"service_s": service}
+
+
+# ---------------------------------------------------------------------------
+# Request bookkeeping
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ModelTally:
+    kind: str = "predict"
+    requests: int = 0
+    ok: int = 0
+    shed: int = 0
+    deadline: int = 0
+    evicted: int = 0
+    error: int = 0
+    latencies: list[float] = field(default_factory=list)
+
+    def percentile(self, p: float) -> float | None:
+        if not self.latencies:
+            return None
+        ordered = sorted(self.latencies)
+        rank = max(0, math.ceil(p / 100.0 * len(ordered)) - 1)
+        return ordered[rank]
+
+
+class ReplayHarness:
+    """One seeded certification run: N simulated members + a leader
+    running the real scrape tree / profiler / SLO evaluator / tracer,
+    driven by an ``OpenLoopArrivals`` schedule on the virtual clock.
+    ``run()`` returns the ``slo_cert.json`` document."""
+
+    def __init__(
+        self,
+        n_members: int,
+        spec: TrafficSpec,
+        *,
+        objectives: dict[str, SloObjective] | None = None,
+        sample_rate: float = 1.0,
+        spans_per_s_budget: float = 0.0,
+        scrape_interval_s: float = 10.0,
+        scrape_timeout_s: float = 1.0,
+        burn_force_sample_s: float = 15.0,
+        fast_burn: float = 6.0,
+        slow_burn: float = 1.5,
+        capacity_headroom: float = 2.0,
+    ):
+        if n_members < 2:
+            raise ValueError("certification needs at least 2 members")
+        self.spec = spec
+        self.sample_rate = float(sample_rate)
+        self.spans_per_s_budget = float(spans_per_s_budget)
+        self.scrape_interval_s = float(scrape_interval_s)
+        self.burn_force_sample_s = float(burn_force_sample_s)
+
+        self.net = SimRpcNetwork()
+        self.leader_addr = "leader:0"
+        self.member_addrs = [f"m{i:03d}:1" for i in range(n_members)]
+        per_member_qps = capacity_headroom * spec.base_rps / n_members
+        self.members = [
+            SimMember(self.net, addr, i, seed=spec.seed,
+                      capacity_qps=per_member_qps,
+                      scrape_timeout_s=scrape_timeout_s)
+            for i, addr in enumerate(self.member_addrs)
+        ]
+        self.leader_registry = Registry()
+        self.leader_obs = observe.ObsService(
+            self.leader_registry, lane=self.leader_addr
+        )
+        self.net.serve(self.leader_addr, self.leader_obs.methods())
+        self.client = self.net.client(self.leader_addr)
+        self.coordinator = ScrapeTreeCoordinator(
+            self.client, clock=self.net.clock, timeout_s=scrape_timeout_s,
+            concurrency=1, metrics=self.leader_registry.counters,
+        )
+        self.profiler = CostProfiler(
+            window_s=5.0, windows=64, clock=self.net.clock, seed=spec.seed
+        )
+        if objectives is None:
+            objectives = self.default_objectives(spec)
+        self.objectives = objectives
+        self.slo = SloEvaluator(
+            self.profiler, objectives,
+            fast_window_s=min(30.0, spec.duration_s),
+            slow_window_s=spec.duration_s,
+            fast_burn=fast_burn, slow_burn=slow_burn, stage="dispatch",
+            metrics=self.leader_registry.counters,
+        )
+        self._dispatch_rng = random.Random(spec.seed ^ 0xD15)
+        self.tallies: dict[str, ModelTally] = {}
+        self.error_traces: set[str] = set()
+        self.scrape_cycles = 0
+        self.leader_scrape_rpcs = 0
+        self.stale_spans_total = 0
+        self.redelegations_total = 0
+        self.force_windows = 0
+
+    @staticmethod
+    def default_objectives(spec: TrafficSpec) -> dict[str, SloObjective]:
+        """One objective per model in the mix: a latency bound between the
+        nominal and straggler service time for its kind, so a healthy
+        fleet passes and a straggler-heavy one visibly burns budget."""
+        out: dict[str, SloObjective] = {}
+        for mix in spec.mixes:
+            bound = KIND_SERVICE_S.get(mix.kind, 0.1) * 2.5
+            out.setdefault(
+                mix.model,
+                SloObjective(model=mix.model, latency_s=bound, availability=0.95),
+            )
+        return out
+
+    # ---- the drive loop ------------------------------------------------
+
+    def run(self) -> dict:
+        tracer = tracing.tracer
+        prev_enabled = tracer.enabled
+        tracer.reset()
+        tracer.enabled = True
+        tracer.set_sampling(
+            rate=self.sample_rate, spans_per_s=self.spans_per_s_budget,
+            clock=self.net.clock,
+        )
+        try:
+            next_scrape = self.scrape_interval_s
+            for t, mix in OpenLoopArrivals(self.spec):
+                while next_scrape <= t:
+                    if next_scrape > self.net.now:
+                        self.net.advance(next_scrape - self.net.now)
+                    self._scrape_cycle()
+                    next_scrape += self.scrape_interval_s
+                if t > self.net.now:
+                    self.net.advance(t - self.net.now)
+                self._dispatch(mix)
+            while next_scrape <= self.spec.duration_s:
+                if next_scrape > self.net.now:
+                    self.net.advance(next_scrape - self.net.now)
+                self._scrape_cycle()
+                next_scrape += self.scrape_interval_s
+            merged_trace = observe.collect_fleet_trace(
+                self.client,
+                [self.leader_addr, *self.member_addrs],
+                timeout=5.0, clock_samples=1,
+            )
+            sampling = tracer.sampling_summary()
+            return self._certificate(merged_trace, sampling)
+        finally:
+            # Restore the process-global tracer exactly as found: default
+            # rate, controller off, REAL clock back in (the sim clock must
+            # not leak into later users of the tracer).
+            tracer.enabled = prev_enabled
+            tracer.set_sampling(rate=1.0, spans_per_s=0.0, clock=time.monotonic)
+            tracer.reset()
+
+    def _scrape_cycle(self) -> None:
+        result = self.coordinator.scrape(self.member_addrs)
+        self.scrape_cycles += 1
+        self.leader_scrape_rpcs += result.leader_rpcs
+        self.stale_spans_total += len(result.stale_spans)
+        self.redelegations_total += result.redelegations
+        for addr, reply in result.members.items():
+            self.profiler.ingest_scrape(addr, reply)
+        self.slo.evaluate()
+        burning = self.slo.burning_models()
+        if burning and self.burn_force_sample_s > 0:
+            # The same hook the real leader runs (cluster/node.py): a model
+            # burning budget flips the whole fleet to forced sampling.
+            tracing.tracer.force_sampling(self.burn_force_sample_s)
+            observe.force_fleet_sampling(
+                self.client, self.member_addrs, self.burn_force_sample_s,
+                timeout=1.0,
+            )
+            self.force_windows += 1
+
+    def _dispatch(self, mix: TrafficMix) -> None:
+        member = self.member_addrs[
+            self._dispatch_rng.randrange(len(self.member_addrs))
+        ]
+        budget = KIND_DEADLINE_S.get(mix.kind, 1.0)
+        tally = self.tallies.setdefault(mix.model, ModelTally(kind=mix.kind))
+        tally.requests += 1
+        trace_id = ""
+        try:
+            with tracing.tracer.span(
+                "loadgen/request", model=mix.model, kind=mix.kind
+            ):
+                ctx = tracectx.current()
+                trace_id = ctx.trace_id if ctx is not None else ""
+                reply = self.client.call(
+                    member, f"job.{mix.kind}",
+                    {"model": mix.model, "kind": mix.kind, "deadline_s": budget},
+                    timeout=budget,
+                )
+        except Overloaded:
+            tally.shed += 1
+            self.error_traces.add(trace_id)
+            return
+        except DeadlineExceeded:
+            tally.deadline += 1
+            tally.latencies.append(budget)
+            self.error_traces.add(trace_id)
+            # The caller waited its whole budget: that latency is real and
+            # lands in the SLO lane as an over-objective observation.
+            self.profiler.record(mix.model, member, "dispatch", budget)
+            return
+        except (RpcUnreachable, RpcError) as e:
+            if "evicted:" in str(e):
+                tally.evicted += 1
+            else:
+                tally.error += 1
+            self.error_traces.add(trace_id)
+            return
+        tally.ok += 1
+        latency = float(reply["service_s"])
+        tally.latencies.append(latency)
+        self.profiler.record(mix.model, member, "dispatch", latency)
+
+    # ---- certificate ---------------------------------------------------
+
+    @staticmethod
+    def _jsonsafe(value):
+        """NaN/inf -> None recursively: the certificate must be strict
+        JSON (the profiler's percentile is NaN on an empty lane)."""
+        if isinstance(value, float) and not math.isfinite(value):
+            return None
+        if isinstance(value, dict):
+            return {k: ReplayHarness._jsonsafe(v) for k, v in value.items()}
+        if isinstance(value, list):
+            return [ReplayHarness._jsonsafe(v) for v in value]
+        return value
+
+    def _certificate(self, merged_trace: dict, sampling: dict) -> dict:
+        slo_status = self.slo.status()
+        merged_trace_ids = {
+            ev["args"]["trace"]
+            for ev in merged_trace.get("traceEvents", ())
+            if ev.get("ph") == "X" and "trace" in (ev.get("args") or {})
+        }
+        error_traces = {t for t in self.error_traces if t}
+        present = error_traces & merged_trace_ids
+        n = len(self.member_addrs)
+        cycles = max(1, self.scrape_cycles)
+        obs_calls = sum(
+            1 for _, method in self.net.calls if method.startswith("obs.")
+        )
+        models: dict[str, dict] = {}
+        for model in sorted(self.tallies):
+            tally = self.tallies[model]
+            slo_model = (slo_status.get("models") or {}).get(model, {})
+            models[model] = {
+                "kind": tally.kind,
+                "requests": tally.requests,
+                "ok": tally.ok,
+                "shed": tally.shed,
+                "deadline": tally.deadline,
+                "evicted": tally.evicted,
+                "error": tally.error,
+                "p50_s": tally.percentile(50),
+                "p99_s": tally.percentile(99),
+                "objective_latency_s": slo_model.get("objective_latency_s"),
+                "availability": slo_model.get("availability"),
+                "fast_burn": slo_model.get("fast_burn", 0.0),
+                "slow_burn": slo_model.get("slow_burn", 0.0),
+                "fast_alert": slo_model.get("fast_alert", False),
+                "slow_alert": slo_model.get("slow_alert", False),
+            }
+        return self._jsonsafe({
+            "version": SLO_CERT_VERSION,
+            "seed": self.spec.seed,
+            "spec": {
+                **self.spec.to_wire(),
+                "members": n,
+                "sample_rate": self.sample_rate,
+                "spans_per_s_budget": self.spans_per_s_budget,
+                "scrape_interval_s": self.scrape_interval_s,
+            },
+            "models": models,
+            "slo": slo_status,
+            "observability": {
+                "scrape_cycles": self.scrape_cycles,
+                "leader_scrape_rpcs_total": self.leader_scrape_rpcs,
+                "leader_rpcs_per_cycle_avg": self.leader_scrape_rpcs / cycles,
+                "members": n,
+                "direct_equivalent_rpcs_per_cycle": n,
+                "sqrt_bound_rpcs_per_cycle": 4.0 * math.sqrt(n),
+                "bound_ok": (
+                    self.leader_scrape_rpcs / cycles <= 4.0 * math.sqrt(n)
+                ),
+                "stale_spans_total": self.stale_spans_total,
+                "redelegations_total": self.redelegations_total,
+                "scrape_rpc_fraction": (
+                    obs_calls / len(self.net.calls) if self.net.calls else 0.0
+                ),
+                "force_windows": self.force_windows,
+                "sampling": sampling,
+            },
+            "traces": {
+                "error_requests": len(error_traces),
+                "error_traces_in_merged": len(present),
+                "all_errors_sampled": error_traces <= merged_trace_ids,
+                "merged_events": sum(
+                    1 for ev in merged_trace.get("traceEvents", ())
+                    if ev.get("ph") == "X"
+                ),
+            },
+        })
+
+
+# ---------------------------------------------------------------------------
+# Certificate schema gate
+# ---------------------------------------------------------------------------
+
+_NUM = (int, float)
+
+# section -> {field: required types} — hand-rolled (no jsonschema dep);
+# None in a type tuple marks the field as nullable.
+_CERT_SHAPE: dict[str, dict[str, tuple]] = {
+    "spec": {
+        "duration_s": _NUM, "base_rps": _NUM, "seed": (int,),
+        "members": (int,), "sample_rate": _NUM, "scrape_interval_s": _NUM,
+        "mixes": (list,), "flash_crowds": (list,),
+    },
+    "observability": {
+        "scrape_cycles": (int,), "leader_scrape_rpcs_total": (int,),
+        "leader_rpcs_per_cycle_avg": _NUM, "members": (int,),
+        "sqrt_bound_rpcs_per_cycle": _NUM, "bound_ok": (bool,),
+        "stale_spans_total": (int,), "redelegations_total": (int,),
+        "sampling": (dict,),
+    },
+    "traces": {
+        "error_requests": (int,), "error_traces_in_merged": (int,),
+        "all_errors_sampled": (bool,), "merged_events": (int,),
+    },
+}
+
+_MODEL_SHAPE: dict[str, tuple] = {
+    "kind": (str,), "requests": (int,), "ok": (int,), "shed": (int,),
+    "deadline": (int,), "evicted": (int,), "error": (int,),
+    "p50_s": (*_NUM, type(None)), "p99_s": (*_NUM, type(None)),
+    "fast_burn": _NUM, "slow_burn": _NUM,
+    "fast_alert": (bool,), "slow_alert": (bool,),
+}
+
+
+def validate_slo_cert(doc: dict) -> list[str]:
+    """Structural validation of one certificate document; returns the list
+    of problems (empty = valid). CI fails the seeded smoke leg on any."""
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not an object"]
+    if doc.get("version") != SLO_CERT_VERSION:
+        problems.append(f"version must be {SLO_CERT_VERSION}")
+    if not isinstance(doc.get("seed"), int):
+        problems.append("seed must be an integer")
+    for section, shape in _CERT_SHAPE.items():
+        body = doc.get(section)
+        if not isinstance(body, dict):
+            problems.append(f"missing section {section!r}")
+            continue
+        for key, types in shape.items():
+            if key not in body:
+                problems.append(f"{section}.{key} missing")
+            elif not isinstance(body[key], types) or (
+                isinstance(body[key], bool) and bool not in types
+            ):
+                problems.append(f"{section}.{key} has wrong type")
+    slo = doc.get("slo")
+    if not isinstance(slo, dict) or not isinstance(slo.get("models"), dict):
+        problems.append("slo.models missing")
+    models = doc.get("models")
+    if not isinstance(models, dict) or not models:
+        problems.append("models section missing or empty")
+        return problems
+    for model, body in models.items():
+        if not isinstance(body, dict):
+            problems.append(f"models.{model} is not an object")
+            continue
+        for key, types in _MODEL_SHAPE.items():
+            if key not in body:
+                problems.append(f"models.{model}.{key} missing")
+            elif not isinstance(body[key], types) or (
+                isinstance(body[key], bool) and bool not in types
+            ):
+                problems.append(f"models.{model}.{key} has wrong type")
+        counted = sum(
+            int(body.get(k) or 0)
+            for k in ("ok", "shed", "deadline", "evicted", "error")
+        )
+        if counted != int(body.get("requests") or 0):
+            problems.append(f"models.{model}: outcome counts != requests")
+    return problems
+
+
+__all__ = [
+    "SLO_CERT_VERSION",
+    "FlashCrowd",
+    "ModelTally",
+    "OpenLoopArrivals",
+    "ReplayHarness",
+    "SimMember",
+    "TrafficMix",
+    "TrafficSpec",
+    "validate_slo_cert",
+]

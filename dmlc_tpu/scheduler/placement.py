@@ -204,6 +204,14 @@ class SloEvaluator:
             }
         return out
 
+    def burning_models(self) -> list[str]:
+        """Models currently in fast-burn alert — what the leader's
+        forced-sampling hook and the SLO-cert harness key off."""
+        with self._lock:
+            return sorted(
+                m for m, st in self._state.items() if st.get("fast_alert")
+            )
+
 
 # ---------------------------------------------------------------------------
 # Placement: greedy cost-balancing with hysteresis + move budget

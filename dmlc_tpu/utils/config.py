@@ -157,6 +157,31 @@ class ClusterConfig:
     # skew on any node, a flight event fires (0 disables the alarm).
     trace_skew_alert_s: float = 0.05
 
+    # --- fleet-scale observability (docs/OBSERVABILITY.md §6-7) ---------
+    # Per-member scrape deadline + leader/delegate concurrency pool: one
+    # wedged member costs one pool slot for one timeout, never the cycle.
+    scrape_timeout_s: float = 2.0
+    scrape_concurrency: int = 8
+    # Delegated scrape tree (cluster/scrapetree.py): past min_members the
+    # leader partitions the ring into spans of scrape_span_size members
+    # (0 = ceil(sqrt(N))) and folds delegate partials — ~O(sqrt(N)) leader
+    # RPCs per cycle instead of O(N). Below the threshold the direct
+    # concurrent scrape is simpler and just as cheap.
+    scrape_tree_enabled: bool = True
+    scrape_tree_min_members: int = 16
+    scrape_span_size: int = 0
+    # Head-based trace sampling (utils/tracing): probability a fresh root
+    # trace is kept (the bit rides the `t` frame field fleet-wide), and an
+    # optional spans/s storage budget the adaptive controller steers the
+    # effective rate toward (0 = controller off). Error/deadline-exceeded
+    # spans are always recorded regardless of the rate.
+    trace_sample_rate: float = 1.0
+    trace_spans_per_s_budget: float = 0.0
+    # On an SLO fast-burn edge, force-sample every trace fleet-wide for
+    # this window (seconds; 0 disables) — burn investigations need whole
+    # traces, not a 1% lottery.
+    trace_burn_force_sample_s: float = 0.0
+
     # --- dynamic request micro-batching (scheduler/worker.DynamicBatcher) ---
     # Coalesce concurrent small `job.predict` requests into device-shaped
     # batches: a request waits at most this long for peers before its batch

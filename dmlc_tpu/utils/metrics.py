@@ -246,6 +246,72 @@ class LatencyStats:
 
 
 # ---------------------------------------------------------------------------
+# Fleet merging: fold many mergeable snapshots into one, exactly
+# ---------------------------------------------------------------------------
+
+
+def merge_counter_dicts(into: dict, part: dict) -> None:
+    """Fold one counters dict into an accumulator: plain counters ADD;
+    ``*_high`` watermarks take the MAX (a fleet high-water mark is the
+    highest any node saw, not a sum)."""
+    for name, value in (part or {}).items():
+        if name.endswith("_high"):
+            prev = into.get(name)
+            into[name] = value if prev is None else max(prev, value)
+        else:
+            into[name] = into.get(name, 0) + value
+
+
+def merge_mergeable_snapshots(parts) -> dict:
+    """Fold ``Registry.snapshot(mergeable=True)``-shaped dicts into ONE
+    mergeable snapshot. Associative — a scrape-tree delegate folds its
+    span's members and the leader folds delegate partials with the same
+    function, and the result is counter-exact either way: counters and
+    histogram bucket counts are integer sums, latency moments merge via
+    Chan's update, reservoirs offer-weighted (``LatencyStats.merge``).
+    Gauges SUM numeric values (fleet totals: pages free, queue depths);
+    ``nodes`` counts contributors so per-node means stay recoverable."""
+    counters: dict = {}
+    gauges: dict = {}
+    latency: dict[str, LatencyStats] = {}
+    nodes = 0
+    for part in parts:
+        if not part:
+            continue
+        nodes += int(part.get("nodes", 1))
+        merge_counter_dicts(counters, part.get("counters") or {})
+        for name, value in (part.get("gauges") or {}).items():
+            if value is None:
+                continue
+            gauges[name] = gauges.get(name, 0.0) + float(value)
+        for name, wire in (part.get("latency") or {}).items():
+            stats = latency.get(name)
+            if stats is None:
+                latency[name] = LatencyStats.from_wire(wire)
+            else:
+                stats.merge(LatencyStats.from_wire(wire))
+    return {
+        "counters": counters,
+        "gauges": gauges,
+        "latency": {n: s.to_wire() for n, s in sorted(latency.items())},
+        "nodes": nodes,
+    }
+
+
+def summarize_mergeable(snapshot: dict) -> dict:
+    """Convert a mergeable snapshot to the standard render shape (latency
+    wire records -> ``summary()`` dicts), so CLI / Prometheus /
+    ``CostProfiler.ingest_scrape`` consumers see exactly what a direct
+    ``Registry.snapshot()`` would have handed them."""
+    out = dict(snapshot)
+    out["latency"] = {
+        n: LatencyStats.from_wire(w).summary()
+        for n, w in sorted((snapshot.get("latency") or {}).items())
+    }
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Registry: one node's whole metric surface behind one snapshot
 # ---------------------------------------------------------------------------
 
@@ -287,11 +353,18 @@ class Registry:
         with self._lock:
             self._gauges[name] = read
 
-    def snapshot(self) -> dict:
+    def snapshot(self, mergeable: bool = False) -> dict:
         """Wire-shaped view of everything: ``{"counters": {...},
-        "gauges": {...}, "latency": {name: summary}}``."""
+        "gauges": {...}, "latency": {name: summary}}``. With ``mergeable``
+        the latency section carries ``LatencyStats.to_wire()`` records
+        instead of summaries — the exact-merge form scrape-tree delegates
+        request so span partials fold counter-exactly into one fleet
+        snapshot (docs/OBSERVABILITY.md §6)."""
         with self._lock:
-            latency = {n: s.summary() for n, s in sorted(self._latency.items())}
+            if mergeable:
+                latency = {n: s.to_wire() for n, s in sorted(self._latency.items())}
+            else:
+                latency = {n: s.summary() for n, s in sorted(self._latency.items())}
             gauges: dict = {}
             for name, read in sorted(self._gauges.items()):
                 try:

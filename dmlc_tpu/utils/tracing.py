@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import contextvars
 import json
+import random
 import threading
 import time
 from contextlib import contextmanager
@@ -87,7 +88,18 @@ class Tracer:
     span entry. Bounded: keeps aggregates forever, raw events up to
     ``max_events`` — newest raw spans are dropped past that, aggregates
     stay exact, and every drop is COUNTED (``dropped_events``) so a
-    truncated timeline is visibly truncated instead of silently short."""
+    truncated timeline is visibly truncated instead of silently short.
+
+    Head-based sampling (docs/OBSERVABILITY.md §7): each fresh ROOT trace
+    is kept with probability ``effective_rate``; the decision rides the
+    wire in the ``t`` frame field so every hop of an unsampled request
+    skips raw span storage (aggregates — the profiler's food — stay exact
+    for every request). An adaptive controller shrinks/regrows the rate
+    toward a spans/s budget, and spans that end in an exception are
+    recorded REGARDLESS of the bit, so error and deadline-exceeded
+    requests always survive into the merged fleet timeline."""
+
+    MIN_SAMPLE_RATE = 1e-3
 
     def __init__(self, max_events: int = 100_000):
         self.enabled = False
@@ -97,6 +109,19 @@ class Tracer:
         self._aggregates: dict[str, LatencyStats] = {}
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
+        # --- head-based sampling state (all guarded by self._lock) ---
+        self.sample_rate = 1.0            # configured base rate for roots
+        self.spans_per_s_budget = 0.0     # adaptive target; 0 = controller off
+        self.adapt_window_s = 5.0
+        self._effective_rate = 1.0
+        self._srng = random.Random(0x5A3B1E)  # sampling is a label, not control flow
+        self._sample_clock = time.monotonic
+        self._sampled_roots = 0
+        self._unsampled_roots = 0
+        self._forced_records = 0
+        self._window_start: float | None = None
+        self._window_records = 0
+        self._force_until: float | None = None
 
     def now(self) -> float:
         """The tracer's own clock (seconds since construction/reset) — the
@@ -109,13 +134,24 @@ class Tracer:
         if not self.enabled:
             yield
             return
-        ctx = tracectx.child()
+        if tracectx.current() is None:
+            ctx = tracectx.child(sampled=self._decide_root())
+        else:
+            ctx = tracectx.child()
         start = time.perf_counter()
+        error: BaseException | None = None
         try:
             with tracectx.bind(ctx):
                 yield
+        except BaseException as e:
+            error = e
+            raise
         finally:
             dur = time.perf_counter() - start
+            if error is not None:
+                attrs = dict(attrs, error=type(error).__name__)
+                if not ctx.sampled:
+                    attrs["forced"] = "error"
             rec = SpanRecord(
                 name, start - self._t0, dur, threading.get_ident(), attrs,
                 trace_id=ctx.trace_id, span_id=ctx.span_id,
@@ -123,7 +159,14 @@ class Tracer:
             )
             with self._lock:
                 self._aggregates.setdefault(name, LatencyStats()).record(dur)
-                self._append_locked(rec)
+                # Forced sampling: a span that raised is stored even when
+                # the head decision said drop — every enclosing span of the
+                # failing request sees the same exception on unwind, so the
+                # whole local chain survives into the merged trace.
+                if ctx.sampled or error is not None:
+                    if error is not None and not ctx.sampled:
+                        self._forced_records += 1
+                    self._append_locked(rec)
 
     def record(self, name: str, duration_s: float, **attrs) -> None:
         """Record an externally-timed duration (e.g. device execution) as a
@@ -139,13 +182,99 @@ class Tracer:
         )
         with self._lock:
             self._aggregates.setdefault(name, LatencyStats()).record(duration_s)
-            self._append_locked(rec)
+            if ctx.sampled:
+                self._append_locked(rec)
 
     def _append_locked(self, rec: SpanRecord) -> None:
+        self._window_records += 1
         if len(self._events) < self.max_events:
             self._events.append(rec)
         else:
             self._dropped += 1
+
+    # ---- head-based sampling -------------------------------------------
+
+    def set_sampling(self, rate=None, spans_per_s=None, clock=None) -> None:
+        """Configure head sampling: ``rate`` is the base keep-probability
+        for fresh roots (clamped to [0, 1]); ``spans_per_s`` a storage
+        budget the adaptive controller steers the effective rate toward
+        (0 disables adaptation); ``clock`` overrides the controller's
+        timebase (the sim harness injects its virtual clock)."""
+        with self._lock:
+            if rate is not None:
+                self.sample_rate = max(0.0, min(1.0, float(rate)))
+                self._effective_rate = self.sample_rate
+            if spans_per_s is not None:
+                self.spans_per_s_budget = max(0.0, float(spans_per_s))
+                if self.spans_per_s_budget <= 0.0:
+                    self._effective_rate = self.sample_rate
+            if clock is not None:
+                self._sample_clock = clock
+            self._window_start = None
+            self._window_records = 0
+
+    def force_sampling(self, seconds: float) -> None:
+        """Sample every fresh root for the next ``seconds`` regardless of
+        rate — the SLO-burn hook: when a model is burning budget, the
+        leader wants whole traces, not a 1% lottery."""
+        with self._lock:
+            until = self._sample_clock() + float(seconds)
+            if self._force_until is None or until > self._force_until:
+                self._force_until = until
+
+    def _decide_root(self) -> bool:
+        with self._lock:
+            now = self._sample_clock()
+            if self._force_until is not None and now < self._force_until:
+                self._sampled_roots += 1
+                return True
+            self._maybe_adapt_locked(now)
+            r = self._effective_rate
+            sampled = r >= 1.0 or (r > 0.0 and self._srng.random() < r)
+            if sampled:
+                self._sampled_roots += 1
+            else:
+                self._unsampled_roots += 1
+            return sampled
+
+    def _maybe_adapt_locked(self, now: float) -> None:
+        if self.spans_per_s_budget <= 0.0:
+            return
+        if self._window_start is None:
+            self._window_start = now
+            self._window_records = 0
+            return
+        dt = now - self._window_start
+        if dt < self.adapt_window_s:
+            return
+        observed = self._window_records / dt
+        budget = self.spans_per_s_budget
+        if observed > budget:
+            # Over budget: cut proportionally (a 10x overshoot drops the
+            # rate 10x in one window, not by baby steps).
+            self._effective_rate = max(
+                self.MIN_SAMPLE_RATE, self._effective_rate * budget / observed
+            )
+        elif observed < 0.5 * budget:
+            # Comfortably under: regrow gently toward the base rate.
+            self._effective_rate = min(self.sample_rate, self._effective_rate * 1.5)
+        self._window_start = now
+        self._window_records = 0
+
+    def sampling_summary(self) -> dict:
+        """Root decisions + controller state, surfaced via ``obs.metrics``
+        so the adaptive behavior is observable fleet-wide."""
+        with self._lock:
+            total = self._sampled_roots + self._unsampled_roots
+            return {
+                "sampled": self._sampled_roots,
+                "unsampled": self._unsampled_roots,
+                "forced_records": self._forced_records,
+                "base_rate": self.sample_rate,
+                "effective_rate": self._effective_rate,
+                "spans_per_s_budget": self.spans_per_s_budget,
+                "observed_rate": (self._sampled_roots / total) if total else 1.0,
+            }
 
     # ---- reporting -----------------------------------------------------
 
@@ -238,6 +367,12 @@ class Tracer:
             self._aggregates.clear()
             self._dropped = 0
             self._t0 = time.perf_counter()
+            self._sampled_roots = 0
+            self._unsampled_roots = 0
+            self._forced_records = 0
+            self._window_start = None
+            self._window_records = 0
+            self._force_until = None
 
 
 # Process-global tracer: subsystems import this; tools flip .enabled.

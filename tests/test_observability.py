@@ -440,3 +440,144 @@ class TestTraceSkew:
              "b": self._node([self._child(0.8)])},
         )
         assert doc["otherData"]["nodes"]["b"]["max_skew_s"] == pytest.approx(0.2)
+
+
+# ---------------------------------------------------------------------------
+# Partial fleets: scrape-tree staleness + streaming trace merge
+# ---------------------------------------------------------------------------
+
+
+def _sim_obs_fleet(n: int):
+    """N sim members each serving the real obs surface (ObsService +
+    ScrapeDelegate) with a distinguishable counter load."""
+    from dmlc_tpu.cluster.observe import ObsService
+    from dmlc_tpu.cluster.rpc import SimRpcNetwork
+    from dmlc_tpu.cluster.scrapetree import ScrapeDelegate
+    from dmlc_tpu.utils.metrics import Registry
+
+    net = SimRpcNetwork()
+    addrs = [f"m{i:02d}:1" for i in range(n)]
+    registries: dict[str, Registry] = {}
+    for i, addr in enumerate(addrs):
+        reg = Registry()
+        reg.counters.inc("work", i + 1)
+        reg.latency("rpc/job.predict").extend([0.01 * (i + 1)] * 3)
+        table = ObsService(reg, lane=addr).methods()
+        table.update(ScrapeDelegate(
+            net.client(addr), timeout_s=1.0, concurrency=1
+        ).methods())
+        net.serve(addr, table)
+        registries[addr] = reg
+    return net, addrs, registries
+
+
+class TestScrapeTreePartialFleet:
+    def _coordinator(self, net, clock=None):
+        from dmlc_tpu.cluster.scrapetree import ScrapeTreeCoordinator
+
+        return ScrapeTreeCoordinator(
+            net.client("leader:0"), clock=clock or net.clock, timeout_s=1.0,
+            concurrency=1,
+        )
+
+    def test_dead_span_is_flagged_stale_not_lost_not_raised(self):
+        # THE pinned contract: every delegate candidate of one span dying
+        # mid-cycle still yields a merged snapshot — the dark span is
+        # FLAGGED stale (never an exception, never silently absent).
+        net, addrs, registries = _sim_obs_fleet(9)  # spans of 3
+        spans_of_three = [addrs[0:3], addrs[3:6], addrs[6:9]]
+        for dead in spans_of_three[1][:2]:  # both delegate candidates
+            net.crash(dead)
+        coord = self._coordinator(net)
+        result = coord.scrape(addrs)  # must not raise
+        assert len(result.stale_spans) == 1
+        assert result.stale_spans[0]["addrs"] == spans_of_three[1]
+        assert result.stale_spans[0]["reason"]
+        # Live spans are all present; the dark span is absent from members
+        # but named in stale_spans — flagged loss, not silent loss.
+        assert sorted(result.members) == sorted(spans_of_three[0] + spans_of_three[2])
+        merged_work = result.merged["counters"]["work"]
+        expected = sum(
+            registries[a].counters.get("work")
+            for a in spans_of_three[0] + spans_of_three[2]
+        )
+        assert merged_work == expected
+
+    def test_stale_for_tracks_last_fresh_stamp(self):
+        net, addrs, _ = _sim_obs_fleet(9)
+        coord = self._coordinator(net)
+        first = coord.scrape(addrs)
+        assert not first.stale_spans and len(first.members) == 9
+        for dead in addrs[3:5]:
+            net.crash(dead)
+        net.advance(5.0)
+        second = coord.scrape(addrs)
+        assert len(second.stale_spans) == 1
+        assert second.stale_spans[0]["stale_for_s"] == pytest.approx(5.0)
+        # Fresh spans carry this cycle's stamp.
+        assert all(t == pytest.approx(5.0) for t in second.stamps.values())
+
+    def test_dead_primary_redelegates_to_next_in_span(self):
+        net, addrs, _ = _sim_obs_fleet(9)
+        net.crash(addrs[3])  # span 2's primary delegate; alternate lives
+        result = self._coordinator(net).scrape(addrs)
+        assert not result.stale_spans
+        assert result.redelegations == 1
+        assert addrs[4] in result.delegates
+        # The crashed node is still a member of the span: it shows up as
+        # missed by the alternate's fan-out, not silently dropped.
+        assert addrs[3] in result.missed
+        assert addrs[3] not in result.members
+
+
+class TestFleetTraceMergerStreaming:
+    @staticmethod
+    def _node(events, offset=0.0, rtt=0.001):
+        return {"dump": {"events": events, "dropped": 0},
+                "offset": offset, "rtt": rtt}
+
+    PARENT = {"name": "rpc/job.predict", "start": 1.0, "dur": 0.5,
+              "span": "s1", "trace": "t1"}
+    CHILD = {"name": "device/forward", "start": 0.9, "dur": 0.1,
+             "span": "s2", "parent": "s1", "trace": "t1"}
+
+    def test_streaming_merge_equals_one_shot(self):
+        from dmlc_tpu.cluster.observe import FleetTraceMerger, merge_fleet_trace
+
+        per_node = {
+            "a": self._node([self.PARENT], offset=0.002),
+            "b": self._node([self.CHILD], offset=-0.001),
+        }
+        one_shot = merge_fleet_trace(per_node, unreachable={"c": "down"})
+        merger = FleetTraceMerger()
+        for addr in sorted(per_node):
+            entry = per_node[addr]
+            merger.add_node(addr, entry["dump"], offset=entry["offset"],
+                            rtt=entry["rtt"])
+        merger.add_unreachable("c", "down")
+        assert merger.finish() == one_shot
+
+    def test_partial_fleet_is_flagged_not_silent(self):
+        from dmlc_tpu.cluster.observe import merge_fleet_trace
+
+        doc = merge_fleet_trace(
+            {"a": self._node([self.PARENT])}, unreachable={"b": "rpc: boom"}
+        )
+        assert doc["otherData"]["unreachable"] == {"b": "rpc: boom"}
+        assert "b" not in doc["otherData"]["nodes"]
+        # The reachable node's spans still made it.
+        assert sum(1 for e in doc["traceEvents"] if e.get("ph") == "X") == 1
+
+    def test_parent_arriving_after_child_still_clamps(self):
+        from dmlc_tpu.cluster.observe import FleetTraceMerger
+
+        # Collection order: the child's node reports BEFORE the parent's —
+        # the deferred clamp pass must still see the parent's start.
+        merger = FleetTraceMerger()
+        merger.add_node("b", {"events": [self.CHILD], "dropped": 0})
+        merger.add_node("a", {"events": [self.PARENT], "dropped": 0})
+        doc = merger.finish()
+        rendered = [e for e in doc["traceEvents"]
+                    if e.get("ph") == "X" and e["name"] == "device/forward"]
+        assert rendered[0]["ts"] == pytest.approx(1.0 * 1e6)
+        assert doc["otherData"]["skew_clamped_children"] == 1

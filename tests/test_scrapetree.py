@@ -1,0 +1,215 @@
+"""Delegated scrape trees (cluster/scrapetree.py, docs/OBSERVABILITY.md §6).
+
+- ``partition_spans``: every member in exactly one contiguous span of
+  ~ceil(sqrt(N)); dedup + deterministic ordering.
+- Counter-exactness: the leader's fold of D delegate partials equals a
+  direct all-member scrape at the same virtual instant — integer-exact
+  for counters, histogram buckets, and sample counts.
+- Re-delegation: a dead primary delegate costs one extra RPC, not the
+  span; a fully dark span is flagged stale (tests/test_observability.py
+  pins the staleness contract itself).
+- The 512-member soak: leader per-cycle scrape cost stays <= 4*sqrt(N)
+  RPCs — the sublinearity ROADMAP item 5 demands — measured on the sim
+  fabric's own call log, not the coordinator's self-report.
+
+DMLC_CHAOS_SEED offsets the seeded load pattern (CI matrix).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import random
+
+import pytest
+
+from dmlc_tpu.cluster.observe import ObsService
+from dmlc_tpu.cluster.rpc import SimRpcNetwork
+from dmlc_tpu.cluster.scrapetree import (
+    ScrapeDelegate,
+    ScrapeTreeCoordinator,
+    partition_spans,
+)
+from dmlc_tpu.utils.metrics import Counters, Registry, merge_mergeable_snapshots
+
+SEED_BASE = int(os.environ.get("DMLC_CHAOS_SEED", "0"))
+
+
+def build_fleet(n: int, seed: int = 0):
+    """N sim members, each with a seeded-random metric load so merges have
+    something nontrivial to be exact about."""
+    rng = random.Random(seed ^ 0x5CA1E)
+    net = SimRpcNetwork()
+    addrs = [f"m{i:03d}:1" for i in range(n)]
+    registries: dict[str, Registry] = {}
+    for i, addr in enumerate(addrs):
+        reg = Registry()
+        reg.counters.inc("requests", rng.randrange(1, 50))
+        if rng.random() < 0.5:
+            reg.counters.inc("shed", rng.randrange(1, 5))
+        reg.counters.observe_high("queue_depth", rng.randrange(1, 30))
+        stats = reg.latency("rpc/job.predict")
+        for _ in range(rng.randrange(1, 8)):
+            stats.record(rng.random() * 0.2)
+        table = ObsService(reg, lane=addr).methods()
+        table.update(ScrapeDelegate(
+            net.client(addr), timeout_s=1.0, concurrency=1
+        ).methods())
+        net.serve(addr, table)
+        registries[addr] = reg
+    return net, addrs, registries
+
+
+def direct_merged(net: SimRpcNetwork, addrs: list[str]) -> dict:
+    """The flat O(N) equivalent the tree must match: every member scraped
+    mergeable directly, folded in one pass."""
+    from dmlc_tpu.cluster.observe import scrape_metrics_with_misses
+
+    replies, misses = scrape_metrics_with_misses(
+        net.client("flat:0"), addrs, timeout=1.0, mergeable=True
+    )
+    assert not misses
+    return merge_mergeable_snapshots([r["metrics"] for r in replies.values()])
+
+
+class TestPartitionSpans:
+    def test_every_member_in_exactly_one_span(self):
+        addrs = [f"m{i:03d}:1" for i in range(37)]
+        spans = partition_spans(addrs)
+        flat = [a for span in spans for a in span]
+        assert sorted(flat) == sorted(addrs)
+        assert len(flat) == len(set(flat))
+
+    def test_span_size_is_ceil_sqrt(self):
+        for n in (1, 2, 3, 4, 16, 17, 100, 511, 512):
+            spans = partition_spans([f"m{i:04d}" for i in range(n)])
+            size = math.isqrt(n - 1) + 1
+            assert all(len(s) <= size for s in spans)
+            assert len(spans) == math.ceil(n / size)
+
+    def test_dedup_and_deterministic_order(self):
+        spans = partition_spans(["b", "a", "b", "c"], span_size=2)
+        assert spans == [["a", "b"], ["c"]]
+
+    def test_explicit_span_size_wins(self):
+        spans = partition_spans([f"m{i}" for i in range(9)], span_size=4)
+        assert [len(s) for s in spans] == [4, 4, 1]
+
+    def test_empty_ring(self):
+        assert partition_spans([]) == []
+
+
+class TestCounterExactness:
+    def test_tree_merge_equals_direct_scrape(self):
+        net, addrs, _ = build_fleet(20, seed=SEED_BASE)
+        coord = ScrapeTreeCoordinator(
+            net.client("leader:0"), clock=net.clock, timeout_s=1.0
+        )
+        result = coord.scrape(addrs)
+        flat = direct_merged(net, addrs)
+        # Integer fields must be EXACT: counters, histogram buckets, and
+        # per-lane sample counts survive any fold association order.
+        assert result.merged["counters"] == flat["counters"]
+        assert result.merged["nodes"] == flat["nodes"] == 20
+        for name, wire in flat["latency"].items():
+            tree_wire = result.merged["latency"][name]
+            assert tree_wire["n"] == wire["n"]
+            assert tree_wire["buckets"] == wire["buckets"]
+            assert tree_wire["mean"] == pytest.approx(wire["mean"])
+            assert tree_wire["m2"] == pytest.approx(wire["m2"])
+
+    def test_high_watermarks_merge_as_max_not_sum(self):
+        net, addrs, registries = build_fleet(9, seed=SEED_BASE + 1)
+        result = ScrapeTreeCoordinator(
+            net.client("leader:0"), clock=net.clock, timeout_s=1.0
+        ).scrape(addrs)
+        expected = max(
+            registries[a].counters.snapshot()["queue_depth_high"] for a in addrs
+        )
+        assert result.merged["counters"]["queue_depth_high"] == expected
+
+    def test_member_replies_keep_flat_scrape_shape(self):
+        # CostProfiler.ingest_scrape and the CLI read summary-form replies;
+        # the tree's per-member entries must stay byte-compatible.
+        net, addrs, _ = build_fleet(6, seed=SEED_BASE)
+        result = ScrapeTreeCoordinator(
+            net.client("leader:0"), clock=net.clock, timeout_s=1.0
+        ).scrape(addrs)
+        for addr in addrs:
+            reply = result.members[addr]
+            lat = reply["metrics"]["latency"]["rpc/job.predict"]
+            assert {"count", "mean", "median", "p99"} <= set(lat)
+            assert "spans" in reply and "sampling" in reply
+
+
+class TestDelegateLimits:
+    def test_max_span_caps_fanout(self):
+        net, addrs, _ = build_fleet(4)
+        delegate = ScrapeDelegate(net.client(addrs[0]), timeout_s=1.0)
+        huge = addrs + [f"ghost{i}:1" for i in range(300)]
+        partial = delegate._scrape_span({"addrs": huge[: 4]})["partial"]
+        assert len(partial["members"]) == 4
+        reply = delegate._scrape_span({"addrs": huge})
+        capped = reply["partial"]
+        total = len(capped["members"]) + len(capped["missed"])
+        assert total <= ScrapeDelegate.MAX_SPAN
+
+    def test_missed_members_counted_in_scrape_timeouts(self):
+        net, addrs, _ = build_fleet(6)
+        counters = Counters()
+        delegate = ScrapeDelegate(
+            net.client(addrs[0]), timeout_s=1.0, metrics=counters
+        )
+        net.crash(addrs[2])
+        net.crash(addrs[4])
+        partial = delegate._scrape_span({"addrs": addrs})["partial"]
+        assert sorted(partial["missed"]) == sorted([addrs[2], addrs[4]])
+        assert counters.get("scrape_timeouts") == 2
+
+
+class TestSoak512:
+    N = 512
+
+    def test_leader_cycle_cost_sublinear_and_counter_exact(self):
+        net, addrs, _ = build_fleet(self.N, seed=SEED_BASE)
+        counters = Counters()
+        coord = ScrapeTreeCoordinator(
+            net.client("leader:0"), clock=net.clock, timeout_s=1.0,
+            metrics=counters,
+        )
+        calls_before = len(net.calls)
+        result = coord.scrape(addrs)
+        # Leader-issued RPCs measured on the FABRIC's log: calls sourced by
+        # the coordinator this cycle are exactly the obs.scrape_span calls
+        # (delegate fan-out dials from the delegates, not the leader).
+        leader_calls = [
+            (a, m) for a, m in net.calls[calls_before:] if m == "obs.scrape_span"
+        ]
+        bound = 4.0 * math.sqrt(self.N)
+        assert len(leader_calls) <= bound
+        assert result.leader_rpcs == len(leader_calls)
+        assert counters.snapshot()["scrape_tree_rpcs_high"] <= bound
+        # Every member reported; the fold is counter-exact vs the direct
+        # O(N) scrape at the same virtual instant.
+        assert len(result.members) == self.N
+        flat = direct_merged(net, addrs)
+        assert result.merged["counters"] == flat["counters"]
+        assert result.merged["nodes"] == self.N
+        for name, wire in flat["latency"].items():
+            assert result.merged["latency"][name]["n"] == wire["n"]
+            assert result.merged["latency"][name]["buckets"] == wire["buckets"]
+
+    def test_bad_cycle_stays_under_double_sqrt_bound(self):
+        # Kill every primary delegate: every span pays the re-delegation
+        # penalty and the cycle still fits the 4*sqrt(N) envelope.
+        net, addrs, _ = build_fleet(self.N, seed=SEED_BASE + 2)
+        spans = partition_spans(addrs)
+        for span in spans:
+            net.crash(span[0])
+        coord = ScrapeTreeCoordinator(
+            net.client("leader:0"), clock=net.clock, timeout_s=1.0
+        )
+        result = coord.scrape(addrs)
+        assert result.redelegations == len(spans)
+        assert result.leader_rpcs <= 4.0 * math.sqrt(self.N)
+        assert not result.stale_spans  # alternates carried every span

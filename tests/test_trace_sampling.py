@@ -1,0 +1,223 @@
+"""Head-based adaptive trace sampling (docs/OBSERVABILITY.md §7).
+
+- The sampled bit is the third element of the ``t`` frame field; legacy
+  2-element frames read as sampled (old peers keep tracing).
+- An unsampled root costs ZERO raw span storage while aggregates — the
+  profiler's food — stay exact for every request.
+- Spans that raise are force-recorded regardless of the bit: error and
+  deadline-exceeded requests always survive into the merged timeline.
+- The adaptive controller shrinks the effective rate toward a spans/s
+  budget and regrows it when load falls.
+- ``obs.trace_ctl`` pushes rate/budget/force knobs fleet-wide.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from dmlc_tpu.cluster import tracectx
+from dmlc_tpu.cluster.rpc import RpcError, SimRpcNetwork
+from dmlc_tpu.utils.tracing import Tracer
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class TestSampledBitWire:
+    def test_wire_carries_sampled_bit(self):
+        assert tracectx.to_wire(tracectx.child(sampled=True))[2] == 1
+        assert tracectx.to_wire(tracectx.child(sampled=False))[2] == 0
+
+    def test_from_wire_round_trip(self):
+        ctx = tracectx.child(sampled=False)
+        back = tracectx.from_wire(tracectx.to_wire(ctx))
+        assert back.trace_id == ctx.trace_id
+        assert back.sampled is False
+
+    def test_legacy_two_element_frame_reads_sampled(self):
+        # Old peers send [trace, span]: absent bit means "keep tracing",
+        # so a mixed-version fleet degrades toward more data, not less.
+        back = tracectx.from_wire(["t1", "s1"])
+        assert back.sampled is True
+
+    def test_children_inherit_the_root_decision(self):
+        root = tracectx.child(sampled=False)
+        with tracectx.bind(root):
+            child = tracectx.child()
+        assert child.sampled is False
+        assert child.trace_id == root.trace_id
+
+    def test_bit_rides_the_sim_fabric(self):
+        net = SimRpcNetwork()
+        net.serve("peer:1", {"ping": lambda p: {}})
+        root = tracectx.child(sampled=False)
+        with tracectx.bind(root):
+            net.client("me:1").call("peer:1", "ping", {})
+        assert net.frames[-1]["t"][2] == 0
+
+
+class TestHeadSampling:
+    def _tracer(self, rate: float, **kw) -> Tracer:
+        t = Tracer()
+        t.enabled = True
+        t.set_sampling(rate=rate, **kw)
+        return t
+
+    def test_unsampled_roots_store_nothing_but_aggregate_exactly(self):
+        t = self._tracer(0.0)
+        for _ in range(10):
+            with t.span("scheduler/dispatch"):
+                pass
+        assert t.events_wire() == []
+        assert t.summary()["scheduler/dispatch"]["count"] == 10.0
+        s = t.sampling_summary()
+        assert s["unsampled"] == 10 and s["sampled"] == 0
+
+    def test_rate_one_keeps_everything(self):
+        t = self._tracer(1.0)
+        for _ in range(5):
+            with t.span("root"):
+                pass
+        assert len(t.events_wire()) == 5
+        assert t.sampling_summary()["sampled"] == 5
+
+    def test_error_spans_force_recorded_at_rate_zero(self):
+        t = self._tracer(0.0)
+        with pytest.raises(RpcError):
+            with t.span("loadgen/request"):
+                with t.span("rpc/job.predict"):
+                    raise RpcError("deadline: too slow")
+        # The WHOLE local chain of the failing request survives: every
+        # enclosing span saw the same exception on unwind.
+        events = t.events_wire()
+        assert {e["name"] for e in events} == {"loadgen/request", "rpc/job.predict"}
+        assert all(e["attrs"]["error"] == "RpcError" for e in events)
+        assert all(e["attrs"]["forced"] == "error" for e in events)
+        assert t.sampling_summary()["forced_records"] == 2
+
+    def test_ok_spans_of_unsampled_trace_stay_dropped(self):
+        t = self._tracer(0.0)
+        with pytest.raises(ValueError):
+            with t.span("root"):
+                with t.span("ok_child"):
+                    pass  # exits cleanly before the failure
+                raise ValueError("later failure")
+        names = {e["name"] for e in t.events_wire()}
+        assert names == {"root"}  # the clean child was already dropped
+
+    def test_forced_window_samples_everything(self):
+        clock = FakeClock()
+        t = self._tracer(0.0, clock=clock)
+        t.force_sampling(10.0)
+        with t.span("root"):
+            pass
+        assert len(t.events_wire()) == 1
+        clock.t = 11.0  # window expired
+        with t.span("root"):
+            pass
+        assert len(t.events_wire()) == 1
+
+    def test_record_honors_the_ambient_bit(self):
+        t = self._tracer(1.0)
+        with tracectx.bind(tracectx.child(sampled=False)):
+            t.record("device/forward", 0.005)
+        assert t.events_wire() == []
+        assert t.summary()["device/forward"]["count"] == 1.0
+
+
+class TestAdaptiveController:
+    def test_rate_shrinks_proportionally_over_budget(self):
+        clock = FakeClock()
+        t = Tracer()
+        t.enabled = True
+        t.set_sampling(rate=1.0, spans_per_s=10.0, clock=clock)
+        t.adapt_window_s = 1.0
+        # 100 spans/s against a 10/s budget for two windows.
+        for _ in range(3):
+            for _ in range(100):
+                with t.span("root"):
+                    pass
+            clock.t += 1.0
+        s = t.sampling_summary()
+        assert s["effective_rate"] < 0.5  # cut hard, not by baby steps
+        assert s["effective_rate"] >= Tracer.MIN_SAMPLE_RATE
+
+    def test_rate_regrows_when_load_falls(self):
+        clock = FakeClock()
+        t = Tracer()
+        t.enabled = True
+        t.set_sampling(rate=1.0, spans_per_s=10.0, clock=clock)
+        t.adapt_window_s = 1.0
+        for _ in range(3):
+            for _ in range(100):
+                with t.span("root"):
+                    pass
+            clock.t += 1.0
+        squeezed = t.sampling_summary()["effective_rate"]
+        for _ in range(20):  # near-idle windows
+            with t.span("root"):
+                pass
+            clock.t += 1.0
+        regrown = t.sampling_summary()["effective_rate"]
+        assert regrown > squeezed
+        assert regrown <= 1.0
+
+    def test_budget_zero_disables_adaptation(self):
+        clock = FakeClock()
+        t = Tracer()
+        t.enabled = True
+        t.set_sampling(rate=0.5, spans_per_s=0.0, clock=clock)
+        for _ in range(50):
+            with t.span("root"):
+                pass
+            clock.t += 0.1
+        assert t.sampling_summary()["effective_rate"] == 0.5
+
+
+class TestTraceCtlKnobs:
+    def _serve_obs(self):
+        from dmlc_tpu.cluster.observe import ObsService
+        from dmlc_tpu.utils.metrics import Registry
+        from dmlc_tpu.utils.tracing import tracer
+
+        net = SimRpcNetwork()
+        net.serve("n1:1", ObsService(Registry(), lane="n1:1").methods())
+        return net, tracer
+
+    def test_sampling_knobs_pushed_over_the_wire(self):
+        net, tracer = self._serve_obs()
+        prev = tracer.enabled
+        try:
+            reply = net.client("cli:0").call(
+                "n1:1", "obs.trace_ctl",
+                {"enable": True, "sample_rate": 0.25, "spans_per_s": 50.0},
+                timeout=2.0,
+            )
+            assert reply["enabled"] is True
+            assert reply["sampling"]["base_rate"] == 0.25
+            assert reply["sampling"]["spans_per_s_budget"] == 50.0
+            forced = net.client("cli:0").call(
+                "n1:1", "obs.trace_ctl", {"force_sample_s": 5.0}, timeout=2.0
+            )
+            assert forced["sampling"]["base_rate"] == 0.25
+        finally:
+            tracer.enabled = prev
+            tracer.set_sampling(rate=1.0, spans_per_s=0.0)
+            tracer.reset()
+
+    def test_metrics_reply_surfaces_sampling_state(self):
+        net, tracer = self._serve_obs()
+        try:
+            reply = net.client("cli:0").call(
+                "n1:1", "obs.metrics", {}, timeout=2.0
+            )
+            assert {"sampled", "unsampled", "effective_rate",
+                    "observed_rate"} <= set(reply["sampling"])
+        finally:
+            tracer.set_sampling(rate=1.0, spans_per_s=0.0)
+            tracer.reset()

@@ -20,7 +20,13 @@
 #                         merged Perfetto JSON must load and spans from
 #                         >= 2 nodes must share one trace_id with correct
 #                         parent ordering (tools/trace_smoke.py)
-#   8. chaos matrix     — the seeded fault-injection suites (crashes,
+#   8. loadgen smoke    — seeded flash-crowd replay through the sim fleet
+#                         (tools/slo_cert.py): fails unless slo_cert.json
+#                         validates against the schema, error traces were
+#                         force-sampled into the merged fleet trace, and
+#                         leader scrape cost held the 4*sqrt(N) tree
+#                         bound; one leg per chaos seed base
+#   9. chaos matrix     — the seeded fault-injection suites (crashes,
 #                         partitions, failover, disk bit-rot/torn writes,
 #                         overload: deadlines/shedding/breakers/gray
 #                         ejection, the generation join/leave soak with
@@ -102,12 +108,22 @@ else
   fail=1
 fi
 
-note "chaos suite (3-seed matrix: crashes/partitions/failover x disk faults x overload x generation soak x placement soak)"
+note "chaos suite (3-seed matrix: crashes/partitions/failover x disk faults x overload x generation soak x placement soak x loadgen SLO cert)"
 for seed_base in 0 1000 2000; do
+  note "loadgen SLO-cert smoke DMLC_CHAOS_SEED=$seed_base (seeded flash-crowd replay)"
+  if env JAX_PLATFORMS=cpu python tools/slo_cert.py --members 24 --duration 90 \
+      --base-rps 30 --flash 30:20:6 --sample-rate 0.01 --seed "$seed_base" \
+      --out "/tmp/slo_cert_$seed_base.json"; then
+    note "loadgen smoke $seed_base OK (/tmp/slo_cert_$seed_base.json)"
+  else
+    note "loadgen smoke $seed_base FAILED (replay: python tools/slo_cert.py --seed $seed_base --out /tmp/slo_cert_$seed_base.json)"
+    fail=1
+  fi
   note "chaos matrix leg DMLC_CHAOS_SEED=$seed_base"
   if env JAX_PLATFORMS=cpu DMLC_CHAOS_SEED="$seed_base" python -m pytest \
       tests/test_chaos.py tests/test_sdfs_faults.py tests/test_overload.py \
       tests/test_generate_cluster.py tests/test_placement.py \
+      tests/test_scrapetree.py tests/test_loadgen.py \
       -q -p no:cacheprovider; then
     note "chaos leg $seed_base OK"
   else

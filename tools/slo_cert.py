@@ -1,0 +1,153 @@
+"""SLO-certification replay CLI (docs/OPERATIONS.md runbook).
+
+Replays a seeded open-loop workload — diurnal curve + flash crowds of
+mixed predict/generate traffic — through a simulated fleet running the
+REAL observability plane (scrape tree, cost profiler, SLO evaluator,
+head-sampled tracer), then writes and validates ``slo_cert.json``.
+
+Exit 0 only if:
+
+- the certificate validates against the schema
+  (dmlc_tpu/loadgen.validate_slo_cert),
+- 100% of error/deadline-exceeded request traces survived head sampling
+  into the merged fleet trace (the forced-sampling contract), and
+- the leader's scrape cost stayed within the 4*sqrt(N) tree bound.
+
+CI runs this as the seeded loadgen smoke leg (tools/ci_check.sh) across
+the DMLC_CHAOS_SEED matrix; same seed -> same certificate counts.
+
+Usage:
+  python tools/slo_cert.py --members 24 --duration 90 --base-rps 30 \
+      --flash 30:20:6 --sample-rate 0.01 --seed 0 --out /tmp/slo_cert.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+try:
+    import _bootstrap  # noqa: F401  (repo-root sys.path for standalone runs)
+except ImportError:
+    pass  # invoked as a module from the repo root
+
+
+def parse_flash(value: str):
+    from dmlc_tpu.loadgen import FlashCrowd
+
+    try:
+        start, duration, mult = (float(x) for x in value.split(":"))
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(
+            f"--flash wants start:duration:multiplier, got {value!r}"
+        ) from e
+    return FlashCrowd(start_s=start, duration_s=duration, multiplier=mult)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--members", type=int, default=24,
+                    help="simulated fleet size (default 24)")
+    ap.add_argument("--duration", type=float, default=90.0,
+                    help="virtual seconds of traffic (default 90)")
+    ap.add_argument("--base-rps", type=float, default=30.0,
+                    help="base offered rate (default 30)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="workload seed; same seed -> same certificate counts")
+    ap.add_argument("--sample-rate", type=float, default=0.01,
+                    help="head-sampling base rate for traces (default 0.01)")
+    ap.add_argument("--spans-per-s", type=float, default=0.0,
+                    help="adaptive controller span budget (0 = off)")
+    ap.add_argument("--flash", type=parse_flash, action="append", default=[],
+                    metavar="START:DUR:MULT",
+                    help="flash crowd (repeatable), e.g. 30:20:6")
+    ap.add_argument("--diurnal", type=float, default=0.2,
+                    help="diurnal amplitude in [0,1] (default 0.2)")
+    ap.add_argument("--diurnal-period", type=float, default=0.0,
+                    help="diurnal period in s (default: 2x duration)")
+    ap.add_argument("--scrape-interval", type=float, default=10.0,
+                    help="leader scrape cadence in virtual s (default 10)")
+    ap.add_argument("--out", default="slo_cert.json",
+                    help="certificate path (default ./slo_cert.json)")
+    return ap
+
+
+def main(argv=None) -> int:
+    from dmlc_tpu.loadgen import (
+        ReplayHarness,
+        TrafficMix,
+        TrafficSpec,
+        validate_slo_cert,
+    )
+
+    args = build_parser().parse_args(argv)
+    flash = args.flash or [parse_flash(f"{args.duration / 3:.0f}:{args.duration / 4.5:.0f}:6")]
+    spec = TrafficSpec(
+        duration_s=args.duration,
+        base_rps=args.base_rps,
+        mixes=(
+            TrafficMix("resnet50", "predict", 0.7),
+            TrafficMix("llm-7b", "generate", 0.3),
+        ),
+        diurnal_amplitude=max(0.0, args.diurnal),
+        diurnal_period_s=args.diurnal_period or 2.0 * args.duration,
+        flash_crowds=tuple(flash),
+        seed=args.seed,
+    )
+    harness = ReplayHarness(
+        args.members, spec,
+        sample_rate=args.sample_rate,
+        spans_per_s_budget=args.spans_per_s,
+        scrape_interval_s=args.scrape_interval,
+    )
+    doc = harness.run()
+    out = Path(args.out)
+    out.write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+
+    failures: list[str] = []
+    problems = validate_slo_cert(doc)
+    failures.extend(f"schema: {p}" for p in problems)
+    traces = doc.get("traces") or {}
+    if traces.get("error_requests", 0) <= 0:
+        failures.append("no error/deadline traffic was generated — the "
+                        "forced-sampling contract went unexercised")
+    elif not traces.get("all_errors_sampled"):
+        failures.append(
+            f"only {traces.get('error_traces_in_merged')} of "
+            f"{traces.get('error_requests')} error traces reached the "
+            "merged fleet trace (force-sampling broke)"
+        )
+    obs = doc.get("observability") or {}
+    if not obs.get("bound_ok"):
+        failures.append(
+            f"leader scrape cost {obs.get('leader_rpcs_per_cycle_avg')} "
+            f"RPCs/cycle exceeds the 4*sqrt(N) bound "
+            f"{obs.get('sqrt_bound_rpcs_per_cycle')}"
+        )
+
+    total = sum(m["requests"] for m in doc["models"].values())
+    print(f"slo_cert: {total} requests over {args.duration:.0f}s virtual, "
+          f"{obs.get('scrape_cycles')} scrape cycles at "
+          f"{obs.get('leader_rpcs_per_cycle_avg', 0):.1f} leader RPCs/cycle "
+          f"(bound {obs.get('sqrt_bound_rpcs_per_cycle', 0):.1f}); "
+          f"{traces.get('error_traces_in_merged')}/{traces.get('error_requests')} "
+          f"error traces merged -> {out}")
+    for model, body in sorted(doc["models"].items()):
+        alert = " FAST-BURN" if body["fast_alert"] else ""
+        p99 = body["p99_s"]
+        obj = body["objective_latency_s"]
+        print(f"  {model:<10} {body['kind']:<8} n={body['requests']:<6} "
+              f"ok={body['ok']} shed={body['shed']} deadline={body['deadline']} "
+              f"evicted={body['evicted']} p99={p99 if p99 is None else round(p99, 3)}"
+              f" obj={obj} burn={body['fast_burn']:.2f}{alert}")
+    if failures:
+        for f in failures:
+            print(f"slo_cert FAIL: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
