@@ -1134,14 +1134,24 @@ def bench_e2e(
     )
     paths = sorted(p for d in sorted(data_dir.iterdir()) for p in d.iterdir())
 
-    engine = InferenceEngine(model, batch_size=batch_size, use_pallas=False)
+    # Device-resize is the e2e leg's DEFAULT (ops/device_resize.py): the
+    # host decodes at the corpus's RAW size — no host resample, the chip
+    # reaches the model's input size via MXU matmuls — so the pipeline's
+    # host ceiling is decode_raw_img_s, not decode_only_img_s (the ~4x
+    # gap this closes: 677.9 -> 2748.6 img/s on the seed corpus).
+    engine = InferenceEngine(
+        model, batch_size=batch_size, use_pallas=False, device_resize_from=RAW_SIZE
+    )
     engine.warmup()
 
-    # Host decode capacity (no device in the loop).
-    pp.load_batch(paths[:batch_size], size=engine.input_size)  # warm the pool
+    # Host decode capacity at the MODEL's input size (decode + host
+    # resample — the pre-device-resize reference the raw leg is judged
+    # against; engine.input_size is RAW now, so name the model size).
+    model_size = engine.spec.input_size
+    pp.load_batch(paths[:batch_size], size=model_size)  # warm the pool
     t0 = time.perf_counter()
     for s in range(0, len(paths), batch_size):
-        pp.load_batch(paths[s : s + batch_size], size=engine.input_size)
+        pp.load_batch(paths[s : s + batch_size], size=model_size)
     decode_s = time.perf_counter() - t0
 
     # Overlapped end-to-end (decode || transfer || device), with the
@@ -1152,19 +1162,28 @@ def bench_e2e(
     # can be attributed to a STAGE (decode vs stage vs dispatch vs sync)
     # instead of just observed at the headline.
     e2e_s = serial_s = stage_seconds = span_aggregates = profile_snapshot = None
+    tier_stats = None
     if time_left() > 0:
+        from dmlc_tpu.cluster.decodetier import DecodeTierClient
         from dmlc_tpu.utils.tracing import tracer
 
+        # Prefetch decode runs through a decode-tier client in LOCAL mode
+        # (no peers): the identical code path a fleet run takes, so the
+        # tier's local/remote/poison counters and fleet decode rate land in
+        # bench_detail.json from the same bookkeeping a cluster reports
+        # (cluster/decodetier.py, docs/INGEST.md §Decode tier).
+        tier = DecodeTierClient(None, lambda: [])
         engine.reset_ingest_stats()
         was_enabled = tracer.enabled
         tracer.reset()
         tracer.enabled = True
         try:
             t0 = time.perf_counter()
-            engine.run_paths_stream(paths)
+            engine.run_paths_stream(paths, decode_source=tier.decode_paths)
             e2e_s = time.perf_counter() - t0
         finally:
             tracer.enabled = was_enabled
+        tier_stats = tier.stats()
         span_aggregates = {
             name: {
                 "count": int(s["count"]),
@@ -1230,6 +1249,12 @@ def bench_e2e(
         # host-side XLA dispatch, sync = host stalls on device results. The
         # dominant stage is the pipeline's bottleneck.
         "stage_seconds": stage_seconds,
+        # Decode-tier bookkeeping for the e2e leg: how many images each
+        # decode lane class handled (local/remote/poison) and the tier's
+        # busy-time decode rate. Local-mode here; a fleet run fills the
+        # remote split from the same counters.
+        "decode_tier": tier_stats,
+        "fleet_decode_img_s": tier_stats.get("fleet_decode_img_s") if tier_stats else None,
         # Tracer span aggregates over the same e2e leg (count/mean/p99 per
         # span name): the regression-attribution record — when e2e_img_s
         # moves between BENCH_r*.json rounds, diff these to name the stage.
@@ -1446,7 +1471,8 @@ def main() -> None:
                 f"decode_raw={e2e['decode_raw_img_s']} img/s "
                 f"e2e={e2e['e2e_img_s']} img/s "
                 f"serial={e2e['serial_img_s']} img/s "
-                f"overlap_speedup={e2e['overlap_speedup']}x",
+                f"overlap_speedup={e2e['overlap_speedup']}x "
+                f"fleet_decode={e2e.get('fleet_decode_img_s')} img/s",
                 file=sys.stderr,
             )
             stages = e2e.get("stage_seconds")

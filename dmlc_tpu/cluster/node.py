@@ -32,6 +32,7 @@ from pathlib import Path
 from dmlc_tpu.cluster import observe
 from dmlc_tpu.cluster.admission import AdmissionGate
 from dmlc_tpu.cluster.clock import Clock
+from dmlc_tpu.cluster.decodetier import DecodeTierClient
 from dmlc_tpu.cluster.failover import LeaderTracker, StandbyLeader
 from dmlc_tpu.cluster.flight import FlightRecorder
 from dmlc_tpu.cluster.membership import MembershipNode
@@ -226,6 +227,9 @@ class ClusterNode:
                     for name in config.job_models
                 }
         self.worker = PredictWorker(backends, gate=self.predict_gate)
+        # Idle decode capacity, scraped fleet-wide by the leader's obs loop
+        # and folded into ingest-aware placement (scheduler/placement.py).
+        self.registry.gauge("decode_lane_idle", self.worker.decode_lane_idle)
         # --- generation serving (dmlc_tpu/generate/, docs/GENERATE.md) --
         # Continuous-batching LM worker: slots join/leave the running
         # decode batch between steps, KV lives in fixed-size pages, and
@@ -343,6 +347,33 @@ class ClusterNode:
                 if hasattr(backend, "image_source") and backend.image_source is None:
                     backend.image_source = source
 
+        # --- fleet decode tier (cluster/decodetier.py, docs/INGEST.md) --
+        # Ship raw JPEG bytes to peers' idle decode lanes so streamed
+        # ingest decode scales with membership instead of one host's
+        # cores. ONE client per node, built here (never per call — lint
+        # H1); backends source run_paths_stream's prefetch through it.
+        # Wired before the DynamicBatcher wrap below so the attribute
+        # lands on the raw backends.
+        self.decode_tier = None
+        if config.decode_tier_enabled:
+            self.decode_tier = DecodeTierClient(
+                self.rpc,
+                lambda: [
+                    a
+                    for a in self.active_member_addrs()
+                    if a != self.self_member_addr
+                ],
+                min_batch=config.decode_tier_min_batch,
+                max_bytes_per_rpc=config.decode_tier_max_bytes_per_rpc,
+                timeout_s=config.rpc_deadline_s,
+                retry_policy=self.retry_policy,
+                metrics=self.metrics,
+                flight=self.flight,
+            )
+            for backend in self.worker.backends.values():
+                if hasattr(backend, "decode_tier"):
+                    backend.decode_tier = self.decode_tier
+
         # Dynamic request micro-batching, wrapped LAST so the wiring above
         # (sdfs / image_source assignment) still hits the raw backends. With
         # a deadline configured, concurrent small `job.predict` RPCs
@@ -406,6 +437,11 @@ class ClusterNode:
                 window_s=self.config.placement_window_s,
                 hysteresis=self.config.placement_hysteresis,
                 exclude_factor=self.config.placement_exclude_factor,
+                # Ingest-aware placement (ISSUE 13): weight assignment
+                # toward members with idle decode lanes and local SDFS
+                # blobs, read from the obs scrape + SDFS directory.
+                decode_idle=self._member_decode_idle,
+                blob_locality=self._member_blob_locality,
             )
         self.scheduler = JobScheduler(
             self.rpc,
@@ -529,6 +565,9 @@ class ClusterNode:
             except Exception:
                 chips = 1
         info: dict = {"chips": int(chips)}
+        # Idle decode lanes right now — the decode tier's capacity signal
+        # for callers that poll node.info instead of the obs scrape.
+        info["decode_lane_idle"] = int(self.worker.decode_lane_idle())
         if self._batchers:
             # Micro-batching observability: per-model coalescing counters
             # (docs/INGEST.md) ride the same member-info RPC the leader
@@ -553,6 +592,24 @@ class ClusterNode:
             w = cached[0] if cached is not None else 1
         self._weight_cache[addr] = (w, now)
         return w
+
+    def _member_decode_idle(self, member: str) -> float | None:
+        """Idle decode lanes from the leader's last obs scrape of this
+        member (the `decode_lane_idle` gauge every node registers). None
+        when the member hasn't been scraped yet — the advisor treats
+        unknown as neutral, never as zero capacity."""
+        reply = self.fleet_metrics.get(member)
+        if not reply:
+            return None
+        v = (reply.get("metrics") or {}).get("gauges", {}).get("decode_lane_idle")
+        return float(v) if v is not None else None
+
+    def _member_blob_locality(self, member: str) -> float | None:
+        """Fraction of the SDFS directory this member replicates — blobs it
+        can decode without fetching first (docs/INGEST.md §Decode tier)."""
+        if self.sdfs_leader is None:
+            return None
+        return self.sdfs_leader.blob_locality(member)
 
     # ---- liveness glue -------------------------------------------------
 

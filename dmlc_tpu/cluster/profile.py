@@ -52,6 +52,7 @@ log = logging.getLogger(__name__)
 # Tracer span name -> pipeline stage (docs/OBSERVABILITY.md lists both).
 SPAN_STAGES: dict[str, str] = {
     "host/decode": "decode",
+    "rpc/job.decode": "decode",
     "rpc/job.decode_gang": "stage",
     "scheduler/dispatch": "dispatch",
     "scheduler/dispatch_gang": "dispatch",

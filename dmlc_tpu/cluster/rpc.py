@@ -76,6 +76,19 @@ class Overloaded(RpcError):
         self.retry_after_s = retry_after_s
 
 
+class DecodeError(RpcError):
+    """The destination executed ``job.decode`` but the shipped bytes were
+    undecodable (poison input, not peer health). Message always carries
+    ``decode_error:`` so the verdict survives the wire. Deliberately NOT in
+    retrypolicy's overload class: a member that answered "your JPEG is
+    garbage" proved its own liveness — charging its breaker or spending
+    retry tokens on the same poison blob would punish the healthy peer for
+    the caller's input."""
+
+    def __init__(self, msg: str):
+        super().__init__(msg if "decode_error:" in msg else f"decode_error: {msg}")
+
+
 def remote_error(msg: str, retry_after_s: float | None = None) -> RpcError:
     """Re-type a remote error string: the server flattened the exception to
     ``ClassName: message``; the prefixes put the type back so client-side
@@ -84,6 +97,8 @@ def remote_error(msg: str, retry_after_s: float | None = None) -> RpcError:
         return DeadlineExceeded(msg)
     if "overloaded:" in msg:
         return Overloaded(msg, retry_after_s=retry_after_s)
+    if "decode_error:" in msg:
+        return DecodeError(msg)
     return RpcError(msg)
 
 

@@ -639,6 +639,19 @@ class SdfsLeaderState:
             self.directory[name].pop(member, None)
         return True
 
+    def locality_of(self, member: str) -> float | None:
+        """Fraction of directory filenames with ANY replica on ``member`` —
+        the ingest-aware placement locality signal (scheduler/placement.py):
+        a member already holding the blobs a predict job reads decodes them
+        without an SDFS fetch first. None while the directory is empty so
+        the advisor treats locality as unknown rather than zero."""
+        if not self.directory:
+            return None
+        mine = sum(
+            1 for ms in self.directory.values() if any(ms.get(member, ()))
+        )
+        return mine / len(self.directory)
+
     def digest_of(self, name: str, version: int) -> str | None:
         return self.digests.get(name, {}).get(version)
 
@@ -752,6 +765,13 @@ class SdfsLeader:
                 "tombstones": dict(self._tombstones),
                 "epoch": list(self.epoch),
             }
+
+    def blob_locality(self, member: str) -> float | None:
+        """Fraction of the directory this member holds a replica of — fed
+        to PlacementAdvisor as the ingest-aware locality signal. None
+        (unknown) while the directory is empty."""
+        with self._lock:
+            return self.state.locality_of(member)
 
     def adopt_state(self, wire: dict) -> None:
         """Standby sync: mirror the active leader's directory wholesale."""

@@ -332,7 +332,11 @@ class InferenceEngine:
 
     @hot_path
     def run_paths_stream(
-        self, paths: Sequence[str], workers: int | None = None, prefetch: int = 2
+        self,
+        paths: Sequence[str],
+        workers: int | None = None,
+        prefetch: int = 2,
+        decode_source=None,
     ) -> BatchResult:
         """Decode overlapped with h2d transfer and device compute (SURVEY §7
         hard part b) — the three-stage ingest pipeline (docs/INGEST.md).
@@ -353,6 +357,13 @@ class InferenceEngine:
         Every stage records into ingest_summary()/the tracer so bench.py's
         e2e leg can attribute wall time to decode vs stage vs compute vs
         sync.
+
+        ``decode_source`` (optional) replaces the LOCAL per-batch decode
+        with an external producer — ``decode_source(paths_chunk, size) ->
+        uint8 [n, size, size, 3]`` — which is how the fleet decode tier
+        (cluster/decodetier.py) plugs in: the prefetch stage still runs on
+        the persistent stage pool and the staging ring/donation path below
+        is untouched; only where the pixels come from changes.
         """
         if not paths:
             raise ValueError("empty path list")
@@ -364,7 +375,10 @@ class InferenceEngine:
             chunk = paths[s : s + self.batch_size]
             t0 = time.perf_counter()
             with tracer.span("host/decode", n=len(chunk)):
-                batch = pp.load_batch(chunk, size=self.input_size, workers=workers)
+                if decode_source is not None:
+                    batch = decode_source(chunk, self.input_size)
+                else:
+                    batch = pp.load_batch(chunk, size=self.input_size, workers=workers)
             if len(chunk) < self.batch_size:
                 pad = np.zeros(
                     (self.batch_size - len(chunk), *batch.shape[1:]), batch.dtype

@@ -250,6 +250,9 @@ class PlacementAdvisor:
         hysteresis: float = 0.15,
         exclude_factor: float = 3.0,
         stage: str = "dispatch",
+        decode_idle: Callable[[str], float | None] | None = None,
+        blob_locality: Callable[[str], float | None] | None = None,
+        ingest_bias: float = 0.3,
     ):
         self.profiler = profiler
         self.flight = flight
@@ -260,6 +263,16 @@ class PlacementAdvisor:
         self.hysteresis = float(hysteresis)
         self.exclude_factor = float(exclude_factor)
         self.stage = stage
+        # Ingest-aware placement (docs/INGEST.md §Decode tier): optional
+        # per-member reads of idle decode lanes (the scraped
+        # ``decode_lane_idle`` gauge) and SDFS blob locality (fraction of
+        # the directory with a replica on that member). A member that can
+        # FEED its chips is worth more than one that must pull every
+        # pixel over the wire.
+        self.decode_idle = decode_idle
+        self.blob_locality = blob_locality
+        self.ingest_bias = float(ingest_bias)
+        self._last_ingest: dict[str, float] = {}
         self._last_plan: PlacementPlan | None = None
         self._excluded: set[str] = set()
         self._moves_used = 0
@@ -282,6 +295,40 @@ class PlacementAdvisor:
         else:
             median = 1.0
         return {m: measured.get(m, median) for m in members}, median
+
+    def _ingest_factors(self, members: list[str]) -> dict[str, float]:
+        """Ingest-aware capacity multipliers: idle decode lanes (normalized
+        to the fleet's best) and SDFS blob locality each add up to
+        ``ingest_bias`` to a member's effective capacity — bounded
+        [1, 1 + 2*bias], so ingest breaks ties and biases assignment but
+        never overrides a measured dispatch-cost cliff. Empty when neither
+        signal is wired (the pre-decode-tier behavior, bit for bit)."""
+        if self.decode_idle is None and self.blob_locality is None:
+            return {}
+        idle: dict[str, float] = {}
+        if self.decode_idle is not None:
+            for m in members:
+                try:
+                    v = self.decode_idle(m)
+                except Exception:
+                    v = None
+                if v is not None and v > 0:
+                    idle[m] = float(v)
+        max_idle = max(idle.values(), default=0.0)
+        out: dict[str, float] = {}
+        for m in members:
+            f = 1.0
+            if max_idle > 0:
+                f += self.ingest_bias * idle.get(m, 0.0) / max_idle
+            if self.blob_locality is not None:
+                try:
+                    loc = self.blob_locality(m)
+                except Exception:
+                    loc = None
+                if loc:
+                    f += self.ingest_bias * min(1.0, max(0.0, float(loc)))
+            out[m] = round(f, 3)
+        return out
 
     def _exclusions(self, costs: dict[str, float], median: float) -> set[str]:
         """Sticky outlier set: enter above ``exclude_factor`` x median,
@@ -343,6 +390,15 @@ class PlacementAdvisor:
             eligible.sort()
         self._excluded = set(excluded)
 
+        # Ingest-aware weighting AFTER exclusion (outliers are judged on
+        # raw dispatch cost alone): a member's effective cost shrinks with
+        # idle decode capacity and blob locality, which flows into both
+        # the greedy deal below and the dispatch-pool weights.
+        ingest = self._ingest_factors(sorted(members))
+        self._last_ingest = ingest
+        if ingest:
+            costs = {m: c / ingest.get(m, 1.0) for m, c in costs.items()}
+
         plan = self._solve(jobs, eligible, costs, chip_weight)
         plan.excluded = sorted(excluded)
         plan.trigger = trigger
@@ -393,8 +449,7 @@ class PlacementAdvisor:
         if self.metrics is not None:
             self.metrics.inc("placement_decisions")
         if self.flight is not None:
-            self.flight.note(
-                "placement_decision",
+            note = dict(
                 trigger=trigger,
                 moves=plan.moves,
                 excluded=",".join(plan.excluded),
@@ -402,6 +457,13 @@ class PlacementAdvisor:
                     f"{n}={len(ms)}" for n, ms in sorted(plan.assignment.items())
                 ),
             )
+            if any(f > 1.0 for f in ingest.values()):
+                # The ingest weighting is part of the routing decision, so
+                # it must be reconstructible from the recorder (lint O2).
+                note["ingest"] = ",".join(
+                    f"{m}={f}" for m, f in sorted(ingest.items()) if f > 1.0
+                )
+            self.flight.note("placement_decision", **note)
         return plan
 
     def _solve(
@@ -480,6 +542,9 @@ class PlacementAdvisor:
             "moves_used": self._moves_used,
             "max_moves": self.max_moves,
             "window_s": self.window_s,
+            "ingest_factors": {
+                m: f for m, f in sorted(self._last_ingest.items()) if f > 1.0
+            },
             "assignment": {} if plan is None else {
                 n: list(ms) for n, ms in sorted(plan.assignment.items())
             },

@@ -16,13 +16,14 @@ from __future__ import annotations
 
 import concurrent.futures
 import logging
+import os
 import threading
 import time
 from collections import OrderedDict
 from pathlib import Path
 from typing import Callable, Protocol, Sequence
 
-from dmlc_tpu.cluster.rpc import Overloaded, RpcError
+from dmlc_tpu.cluster.rpc import DecodeError, Overloaded, RpcError
 from dmlc_tpu.utils.hotpath import hot_path
 from dmlc_tpu.utils.metrics import LatencyStats
 from dmlc_tpu.utils.tracing import traced_methods, tracer
@@ -233,16 +234,69 @@ class PredictWorker:
     the leader is about to retry anyway (the scheduler's gang breaker is the
     backpressure there)."""
 
-    def __init__(self, backends: dict[str, PredictFn], gate=None):
+    def __init__(self, backends: dict[str, PredictFn], gate=None,
+                 decode_lanes: int | None = None):
         self.backends = dict(backends)
         self.gate = gate
+        # Decode-tier lane accounting (docs/INGEST.md §Decode tier): this
+        # host can usefully run ~one JPEG decode per core; idle lanes =
+        # lanes minus in-flight ``job.decode`` RPCs. Exported as the
+        # per-member ``decode_lane_idle`` gauge so the leader's
+        # ingest-aware placement (and `metrics fleet --worst`) can see
+        # which members have spare decode capacity.
+        self.decode_lanes = int(decode_lanes or min(32, (os.cpu_count() or 4)))
+        self._decode_active = 0
+        self._decode_lock = threading.Lock()
 
     def methods(self) -> dict:
         return traced_methods({
             "job.predict": self._predict,
             "job.predict_gang": self._predict_gang,
             "job.decode_gang": self._decode_gang,
+            "job.decode": self._decode,
         })
+
+    def decode_lane_idle(self) -> int:
+        """Idle decode lanes right now (gauge read; never negative)."""
+        with self._decode_lock:
+            return max(0, self.decode_lanes - self._decode_active)
+
+    def _decode(self, p: dict) -> dict:
+        """Decode-tier member verb: raw encoded-image BYTES in, one
+        device-ready uint8 tensor block out (``data`` = C-contiguous
+        [n, size, size, 3] bytes). Rides the member's persistent decode
+        pool (native when built, the cached PIL pool otherwise), is
+        admission-gated by the SAME predict gate (decode work competes
+        with shards for this host's CPU), and inherits the caller's
+        deadline/trace ambiently like every traced method. Undecodable
+        blobs answer a typed ``DecodeError`` naming the poison indices —
+        the leader retries those locally, never here."""
+        import numpy as np
+
+        from dmlc_tpu.ops import preprocess as pp
+
+        blobs = list(p["blobs"])
+        size = int(p["size"])
+        if self.gate is not None:
+            with self.gate.admit():
+                out, status = self._decode_tracked(pp, blobs, size)
+        else:
+            out, status = self._decode_tracked(pp, blobs, size)
+        if status.any():
+            bad = [int(i) for i in np.nonzero(status)[0]]
+            raise DecodeError(
+                f"{len(bad)}/{len(blobs)} blobs undecodable (indices {bad[:16]})"
+            )
+        return {"n": len(blobs), "size": size, "data": out.tobytes()}
+
+    def _decode_tracked(self, pp, blobs: list, size: int):
+        with self._decode_lock:
+            self._decode_active += 1
+        try:
+            return pp.decode_blobs(blobs, size=size)
+        finally:
+            with self._decode_lock:
+                self._decode_active -= 1
 
     def _decode_gang(self, p: dict) -> dict:
         """Prefetch decode for an upcoming gang shard: the leader calls this
@@ -322,6 +376,7 @@ class EngineBackend:
         mesh=None,
         variables=None,
         dtype=None,
+        device_resize_from: int | None = None,
     ):
         self.model_name = model_name
         self.data_dir = Path(data_dir)
@@ -329,6 +384,15 @@ class EngineBackend:
         # Optional synsets -> local paths resolver (e.g. an SdfsImageSource
         # for the BASELINE "SDFS shard" config); None = local fixture dirs.
         self.image_source = image_source
+        # Device-side resize (ops/device_resize.py): decode at this RAW
+        # size on the host (no host resample) and reach the model's input
+        # size on the chip — the decode tier's peers then ship near-raw
+        # uint8 and the host CPU sheds the ~35% that resample costs.
+        self.device_resize_from = device_resize_from
+        # Fleet decode tier client (cluster/decodetier.py), wired by the
+        # node when decode_tier_enabled: multi-batch shards source their
+        # prefetch decode through it instead of only the local stage pool.
+        self.decode_tier = None
         # Optional engine construction overrides: a GLOBAL (multi-process)
         # mesh makes this backend gang-capable — predict_gang answers its
         # rank's slice of a collectively-executed shard. Variables must then
@@ -369,6 +433,8 @@ class EngineBackend:
                 kw["variables"] = self.variables
             if self.dtype is not None:
                 kw["dtype"] = self.dtype
+            if self.device_resize_from is not None:
+                kw["device_resize_from"] = self.device_resize_from
             self._engine = InferenceEngine(
                 self.model_name, batch_size=self.batch_size, **kw
             )
@@ -384,8 +450,17 @@ class EngineBackend:
                 result = engine.run_paths(paths)
             else:
                 # Multi-batch shard: decode batch i+1 while the device runs
-                # batch i (SURVEY §7 hard part b).
-                result = engine.run_paths_stream(paths)
+                # batch i (SURVEY §7 hard part b). With the fleet decode
+                # tier wired, that prefetch decode fans out across peers'
+                # idle decode lanes instead of only the local stage pool.
+                result = engine.run_paths_stream(
+                    paths,
+                    decode_source=(
+                        self.decode_tier.decode_paths
+                        if self.decode_tier is not None
+                        else None
+                    ),
+                )
             return [int(x) for x in result.top1_index]
 
     def decode_gang(self, synsets: Sequence[str], rank: int, world: int) -> bool:

@@ -215,6 +215,16 @@ class ClusterConfig:
     # building the model from source — the native-serving deployment shape
     # (models/export.py): members need only the artifact + weights blobs.
     serve_from_executable: bool = False
+    # --- fleet decode tier (cluster/decodetier.py, docs/INGEST.md) ---
+    # Ship raw JPEG bytes to peers' job.decode verbs so ingest decode
+    # scales with membership instead of capping at one host's cores.
+    # min_batch: batches below this many images decode locally (the RPC
+    # round-trip would cost more than the decode). max_bytes_per_rpc:
+    # per-chunk wire bound — one oversized batch must never wedge a
+    # control frame.
+    decode_tier_enabled: bool = False
+    decode_tier_min_batch: int = 16
+    decode_tier_max_bytes_per_rpc: int = 4 * 1024 * 1024
 
     # --- generation serving (dmlc_tpu/generate/, docs/GENERATE.md) ---
     # Registry LMs (kind="lm", e.g. "lm_small") this node serves through

@@ -272,6 +272,46 @@ def test_a3_bounded_call_is_clean(tmp_path):
     assert [f for f in findings if f.rule == "A3"] == []
 
 
+def test_a3_catches_deadline_less_decode_tier_relay(tmp_path):
+    # ISSUE 13 fixture: a decode-tier fan-out reached from a served handler
+    # must carry the inbound budget — a deadline-less job.decode hop hangs
+    # the reassembly barrier on one dead peer.
+    files = {
+        "svc.py": """
+            from fx13.tier import fan_out
+
+
+            class Ingest:
+                def __init__(self, rpc):
+                    self.rpc = rpc
+
+                def methods(self):
+                    return {"job.predict": self._predict}
+
+                def _predict(self, p):
+                    return fan_out(self.rpc, p["blobs"])
+        """,
+        "tier.py": """
+            def fan_out(rpc, blobs):
+                return rpc.call("peer:1", "job.decode", {"size": 224, "blobs": blobs})
+        """,
+    }
+    findings = analyze(tmp_path, "fx13", files)
+    a3 = [f for f in findings if f.rule == "A3"]
+    assert len(a3) == 1, [f.message for f in findings]
+    assert a3[0].path == "fx13/tier.py"
+    # Bounding the hop clears it.
+    files["tier.py"] = """
+        def fan_out(rpc, blobs, timeout_s=30.0):
+            return rpc.call(
+                "peer:1", "job.decode", {"size": 224, "blobs": blobs},
+                timeout=timeout_s,
+            )
+    """
+    findings = analyze(tmp_path / "bounded", "fx13", files)
+    assert [f for f in findings if f.rule == "A3"] == []
+
+
 def test_a3_r1_scope_is_not_rereported(tmp_path):
     # Inside dmlc_tpu/cluster/, the bare call is R1's finding, not A3's.
     src = """

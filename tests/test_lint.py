@@ -503,6 +503,42 @@ def test_h1_silent_on_engine_scope_allocator():
     assert fired(src, "dmlc_tpu/generate/x.py") == []
 
 
+def test_h1_fires_on_decode_tier_client_built_per_call():
+    # ISSUE 13 fixture: DecodeTierClient owns a persistent fan-out
+    # executor — constructing one inside a hot path spawns+joins that pool
+    # per decode batch, the exact churn the decode tier exists to avoid.
+    src = """
+    from dmlc_tpu.cluster.decodetier import DecodeTierClient
+
+    from dmlc_tpu.utils.hotpath import hot_path
+
+    @hot_path
+    def decode_batch(rpc, members, blobs):
+        tier = DecodeTierClient(rpc, members)
+        return tier.decode_batch(blobs, 224)
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == ["H1"]
+
+
+def test_h1_silent_on_node_scope_decode_tier_client():
+    # The correct shape (cluster/node.py): ONE client per node, hot paths
+    # only submit batches to it.
+    src = """
+    from dmlc_tpu.cluster.decodetier import DecodeTierClient
+
+    from dmlc_tpu.utils.hotpath import hot_path
+
+    class Node:
+        def __init__(self, rpc, members):
+            self.decode_tier = DecodeTierClient(rpc, members)  # once
+
+        @hot_path
+        def ingest(self, blobs):
+            return self.decode_tier.decode_batch(blobs, 224)
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == []
+
+
 def test_h1_suppression_with_justification():
     src = """
     import threading
@@ -654,6 +690,25 @@ def test_r1_only_matches_rpc_receivers_and_scope():
     # Out of scope: parallel/, ops/, tests/ keep their own conventions.
     assert fired(unbounded, "dmlc_tpu/parallel/x.py") == []
     assert fired(unbounded, "tests/x.py") == []
+
+
+def test_r1_fires_on_deadline_less_job_decode():
+    # ISSUE 13 fixture: a decode-tier fan-out RPC without a bound hangs the
+    # whole reassembly barrier on one dead peer — job.decode must carry a
+    # timeout like every other verb.
+    src = """
+    def _decode_chunk(self, dest, blobs, size):
+        return self.rpc.call(dest, "job.decode", {"size": size, "blobs": blobs})
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == ["R1"]
+    bounded = """
+    def _decode_chunk(self, dest, blobs, size):
+        return self.rpc.call(
+            dest, "job.decode", {"size": size, "blobs": blobs},
+            timeout=self.timeout_s,
+        )
+    """
+    assert fired(bounded, "dmlc_tpu/cluster/x.py") == []
 
 
 def test_r1_suppression_with_justification():

@@ -212,6 +212,55 @@ class TestPlacementAdvisor:
             m != "m3" for ms in second.assignment.values() for m in ms
         )
 
+    def test_ingest_factors_bias_weights_and_are_flight_stamped(self):
+        # ISSUE 13: with equal measured dispatch cost, the member that can
+        # FEED its chips (idle decode lanes + local SDFS blobs) earns the
+        # larger dispatch-pool share — and the factors are reconstructible
+        # from the flight recorder (lint O2) and advisor status.
+        clock = VClock()
+        prof = make_profiler(clock)
+        flight = FlightRecorder(clock=clock)
+        idle = {"m0": 0.0, "m1": 8.0}
+        locality = {"m0": 0.0, "m1": 1.0}
+        adv = PlacementAdvisor(
+            prof, flight=flight, clock=clock,
+            decode_idle=idle.get, blob_locality=locality.get,
+        )
+        feed(prof, {"m0": 0.2, "m1": 0.2})
+        plan = adv.advise({"job": 100}, ["m0", "m1"])
+        # Bounded bias: full idle + full locality = 1 + 2 * ingest_bias.
+        assert adv.status()["ingest_factors"] == {"m1": 1.6}
+        assert plan.weights["job"]["m1"] > plan.weights["job"]["m0"]
+        note = next(
+            e for e in flight.events() if e["kind"] == "placement_decision"
+        )
+        assert "m1=1.6" in note["ingest"]
+
+    def test_no_ingest_signals_means_pre_tier_behavior(self):
+        clock = VClock()
+        prof = make_profiler(clock)
+        adv = PlacementAdvisor(prof, clock=clock)
+        feed(prof, {"m0": 0.1, "m1": 0.4})
+        plan = adv.advise({"job": 100}, ["m0", "m1"])
+        # Neither callable wired: factors empty, weights exactly the
+        # measured-cost normalization (bit-for-bit pre-decode-tier).
+        assert adv.status()["ingest_factors"] == {}
+        assert plan.weights["job"] == {"m0": 4, "m1": 1}
+
+    def test_unknown_ingest_readings_stay_neutral(self):
+        # A member the leader has not scraped yet (None) must not read as
+        # zero capacity — factors only ever help, never penalize below 1x.
+        clock = VClock()
+        prof = make_profiler(clock)
+        adv = PlacementAdvisor(
+            prof, clock=clock,
+            decode_idle=lambda m: None, blob_locality=lambda m: None,
+        )
+        feed(prof, {"m0": 0.1, "m1": 0.4})
+        plan = adv.advise({"job": 100}, ["m0", "m1"])
+        assert adv.status()["ingest_factors"] == {}
+        assert plan.weights["job"] == {"m0": 4, "m1": 1}
+
 
 # ---------------------------------------------------------------------------
 # SloEvaluator: burn rates and alert edges

@@ -108,7 +108,7 @@ else
   fail=1
 fi
 
-note "chaos suite (3-seed matrix: crashes/partitions/failover x disk faults x overload x generation soak x placement soak x loadgen SLO cert)"
+note "chaos suite (3-seed matrix: crashes/partitions/failover x disk faults x overload x generation soak x placement soak x decode-tier kills x loadgen SLO cert)"
 for seed_base in 0 1000 2000; do
   note "loadgen SLO-cert smoke DMLC_CHAOS_SEED=$seed_base (seeded flash-crowd replay)"
   if env JAX_PLATFORMS=cpu python tools/slo_cert.py --members 24 --duration 90 \
@@ -124,10 +124,11 @@ for seed_base in 0 1000 2000; do
       tests/test_chaos.py tests/test_sdfs_faults.py tests/test_overload.py \
       tests/test_generate_cluster.py tests/test_placement.py \
       tests/test_scrapetree.py tests/test_loadgen.py \
+      tests/test_decodetier.py \
       -q -p no:cacheprovider; then
     note "chaos leg $seed_base OK"
   else
-    note "chaos leg $seed_base FAILED (replay: DMLC_CHAOS_SEED=$seed_base pytest tests/test_chaos.py tests/test_sdfs_faults.py tests/test_overload.py tests/test_generate_cluster.py tests/test_placement.py)"
+    note "chaos leg $seed_base FAILED (replay: DMLC_CHAOS_SEED=$seed_base pytest tests/test_chaos.py tests/test_sdfs_faults.py tests/test_overload.py tests/test_generate_cluster.py tests/test_placement.py tests/test_decodetier.py)"
     fail=1
   fi
 done

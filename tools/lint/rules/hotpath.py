@@ -53,6 +53,12 @@ _POOL_CTORS = {
     "dmlc_tpu.generate.kvcache.PageAllocator": "PageAllocator",
     "dmlc_tpu.generate.kvcache.PagedKVCache": "PagedKVCache",
     "dmlc_tpu.generate.engine.GenerationEngine": "GenerationEngine",
+    # Decode-tier client (dmlc_tpu/cluster/decodetier.py): owns a
+    # persistent fan-out executor sized to the peer set. Constructing one
+    # per decode call spawns+joins that pool per batch — exactly the churn
+    # this rule exists to keep off the serving path. One client per node
+    # (cluster/node.py wiring), submit batches to it.
+    "dmlc_tpu.cluster.decodetier.DecodeTierClient": "DecodeTierClient",
 }
 
 
