@@ -50,7 +50,7 @@ class DiskIo:
     """
 
     def open_write(self, path: str | Path) -> BinaryIO:
-        return open(path, "wb")  # dmlc-lint: disable=F1 -- this IS the atomic-write helper's primitive; callers only reach it via temp+fsync+rename
+        return open(path, "wb")  # the atomic-write helper's raw primitive
 
     def open_read(self, path: str | Path) -> BinaryIO:
         return open(path, "rb")

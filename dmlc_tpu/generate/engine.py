@@ -32,6 +32,8 @@ the baseline the 2x continuous-batching pin measures against.
 
 from __future__ import annotations
 
+from typing import Any
+
 import numpy as np
 
 from dmlc_tpu.generate.kvcache import SCRATCH_PAGE, PagedKVCache
@@ -42,7 +44,7 @@ from dmlc_tpu.generate.kvcache import SCRATCH_PAGE, PagedKVCache
 # ---------------------------------------------------------------------------
 
 
-def _layer_norm(x, p):
+def _layer_norm(x: Any, p: Any) -> Any:
     # flax.linen.LayerNorm semantics: population moments over the last
     # axis, epsilon 1e-6, learned scale + bias.
     import jax.numpy as jnp
@@ -52,11 +54,11 @@ def _layer_norm(x, p):
     return (x - mean) / jnp.sqrt(var + 1e-6) * p["scale"] + p["bias"]
 
 
-def _dense(x, p):
+def _dense(x: Any, p: Any) -> Any:
     return x @ p["kernel"] + p["bias"]
 
 
-def _split_heads(x, num_heads):
+def _split_heads(x: Any, num_heads: int) -> Any:
     # [..., D] -> [..., H, Dh]
     return x.reshape(*x.shape[:-1], num_heads, x.shape[-1] // num_heads)
 
@@ -76,8 +78,8 @@ class GenerationEngine:
         self,
         model_name: str,
         *,
-        variables=None,
-        dtype=None,
+        variables: Any = None,
+        dtype: Any = None,
         max_slots: int = 8,
         page_size: int = 16,
         num_pages: int = 128,
@@ -86,7 +88,7 @@ class GenerationEngine:
         use_pallas: bool | None = None,
         return_logits: bool = False,
         seed: int = 0,
-    ):
+    ) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -163,10 +165,11 @@ class GenerationEngine:
 
     # ---- forward math ---------------------------------------------------
 
-    def _params(self, variables):
+    def _params(self, variables: Any) -> Any:
         return variables["params"]
 
-    def _attend(self, q, k_state, v_state, layer, page_table, kv_lengths, slots=None):
+    def _attend(self, q: Any, k_state: Any, v_state: Any, layer: int,
+                page_table: Any, kv_lengths: Any, slots: Any = None) -> Any:
         """Per-layer decode attention: paged gather + ragged mask, or the
         contiguous per-slot view. q: [B, H, Dh] -> [B, H, Dh]."""
         from dmlc_tpu.ops.ragged_decode import (
@@ -181,7 +184,7 @@ class GenerationEngine:
             k, v = k_state[layer], v_state[layer]  # [B, S_max, H, Dh]
         return ragged_decode_attention(q, k, v, kv_lengths)
 
-    def _build_step(self):
+    def _build_step(self) -> Any:
         import jax
         import jax.numpy as jnp
 
@@ -190,8 +193,9 @@ class GenerationEngine:
         num_layers = self.num_layers
         return_logits = self.return_logits
 
-        def step(variables, k_state, v_state, tokens, lengths, active, page_table,
-                 key, temps):
+        def step(variables: Any, k_state: Any, v_state: Any, tokens: Any,
+                 lengths: Any, active: Any, page_table: Any, key: Any,
+                 temps: Any) -> Any:
             p = self._params(variables)
             pos = jnp.minimum(lengths, self.max_len - 1)
             x = p["embed"]["embedding"][tokens] + p["pos_embed"]["embedding"][pos]
@@ -232,7 +236,7 @@ class GenerationEngine:
 
         return jax.jit(step, donate_argnums=(1, 2))
 
-    def _build_prefill(self):
+    def _build_prefill(self) -> Any:
         import jax
         import jax.numpy as jnp
 
@@ -243,7 +247,8 @@ class GenerationEngine:
         page_size = self.cache.page_size if self.cache_mode == "paged" else 0
         s_pad = self.max_prefill
 
-        def prefill(variables, tokens, length, k_state, v_state, dest, key, temp):
+        def prefill(variables: Any, tokens: Any, length: Any, k_state: Any,
+                    v_state: Any, dest: Any, key: Any, temp: Any) -> Any:
             """tokens: [1, s_pad]; length: [] int32 (real prompt length);
             dest: page row [max_pages_per_slot] (paged) or slot index []
             (contiguous)."""
@@ -306,7 +311,7 @@ class GenerationEngine:
     def free_slots(self) -> list[int]:
         return [s for s in range(self.max_slots) if not self.active[s]]
 
-    def join(self, slot: int, prompt, *, temperature: float = 0.0,
+    def join(self, slot: int, prompt: Any, *, temperature: float = 0.0,
              pages: list[int] | None = None) -> int:
         """Prefill ``prompt`` into ``slot`` and return the first sampled
         token. ``pages`` is the submit-time reservation (paged mode)."""
@@ -411,7 +416,7 @@ class GenerationEngine:
             return self.cache.release(slot)
         return []
 
-    def _set_state(self, k_state, v_state) -> None:
+    def _set_state(self, k_state: Any, v_state: Any) -> None:
         self._k_state = k_state
         self._v_state = v_state
         if self.cache_mode == "paged":
@@ -428,7 +433,7 @@ class GenerationEngine:
     def pages_free(self) -> int:
         return self.cache.pages_free if self.cache_mode == "paged" else 0
 
-    def jit_cache_sizes(self) -> dict:
+    def jit_cache_sizes(self) -> dict[str, int]:
         """Compiled-entry counts for the two programs — the recompile-free
         invariant's measurement (must stay 1 apiece at any request mix)."""
         return {
@@ -436,7 +441,7 @@ class GenerationEngine:
             "prefill": self._prefill._cache_size(),
         }
 
-    def load_variables(self, variables) -> None:
+    def load_variables(self, variables: Any) -> None:
         """Hot-swap weights (the `train` verb's member side). Same shapes
         by construction (ModelLoader validated against the registry
         template), so the jit cache entries are reused, not recompiled."""
@@ -444,8 +449,8 @@ class GenerationEngine:
 
         self._variables = jax.device_put(variables)
 
-    def summary(self) -> dict:
-        out = {
+    def summary(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
             "model": self.model_name,
             "cache": self.cache_mode,
             "max_slots": self.max_slots,
@@ -459,7 +464,7 @@ class GenerationEngine:
         return out
 
 
-def _sample(logits, key, temps):
+def _sample(logits: Any, key: Any, temps: Any) -> Any:
     """Greedy at temperature <= 0, categorical at T otherwise — per row.
     logits: [B, V] f32; temps: [B] f32."""
     import jax

@@ -33,6 +33,7 @@ pool being full is an overload condition, not an error.
 from __future__ import annotations
 
 import threading
+from typing import Any
 
 import numpy as np
 
@@ -49,7 +50,7 @@ class PageAllocator:
     a just-released page is the next one handed out — which is exactly
     what the cross-slot-contamination tests want to stress."""
 
-    def __init__(self, num_pages: int, page_size: int):
+    def __init__(self, num_pages: int, page_size: int) -> None:
         if num_pages < 2:
             raise ValueError("need at least 2 pages (page 0 is reserved scratch)")
         if page_size <= 0:
@@ -108,7 +109,7 @@ class PageAllocator:
                 self._free.append(p)
                 self.frees += 1
 
-    def summary(self) -> dict:
+    def summary(self) -> dict[str, int]:
         with self._lock:
             return {
                 "pages_total": self.pages_total,
@@ -139,8 +140,8 @@ class PagedKVCache:
         head_dim: int,
         max_slots: int,
         max_pages_per_slot: int,
-        dtype=None,
-    ):
+        dtype: Any = None,
+    ) -> None:
         import jax.numpy as jnp
 
         self.num_layers = int(num_layers)

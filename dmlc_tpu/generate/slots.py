@@ -42,7 +42,9 @@ from __future__ import annotations
 import logging
 import os
 import threading
+from collections.abc import Callable, Iterable
 from time import monotonic
+from typing import Any, NoReturn
 
 from dmlc_tpu.cluster import deadline as deadline_mod
 from dmlc_tpu.cluster import tracectx
@@ -65,7 +67,7 @@ class GenStream:
     poll re-reads the same chunks and the consumer dedups by seq.
     ``tokens()``/``wait`` serve in-process consumers (CLI, tests)."""
 
-    def __init__(self, request_id: str):
+    def __init__(self, request_id: str) -> None:
         self.request_id = request_id
         self._cv = threading.Condition()
         self._chunks: list[tuple[int, list[int]]] = []
@@ -98,7 +100,7 @@ class GenStream:
 
     # ---- consumer --------------------------------------------------------
 
-    def chunks_after(self, ack: int) -> dict:
+    def chunks_after(self, ack: int) -> dict[str, Any]:
         """The poll reply body: unacked chunks + completion state. ``ack``
         is cumulative — chunks with seq <= ack are dropped for good."""
         with self._cv:
@@ -145,8 +147,10 @@ class _Slot:
         "deadline", "trace_ctx", "pages", "emitted", "slot", "submitted_t",
     )
 
-    def __init__(self, stream, prompt, max_new_tokens, temperature, eos_id,
-                 deadline, trace_ctx, pages, submitted_t):
+    def __init__(self, stream: GenStream, prompt: list[int],
+                 max_new_tokens: int, temperature: float, eos_id: int | None,
+                 deadline: Any, trace_ctx: Any, pages: list[int],
+                 submitted_t: float) -> None:
         self.stream = stream
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -166,19 +170,19 @@ class SlotScheduler:
 
     def __init__(
         self,
-        engine,
+        engine: Any,
         *,
         max_waiting: int = 0,
         name: str = "generate",
-        metrics=None,
-        flight=None,
-        registry=None,
+        metrics: Any = None,
+        flight: Any = None,
+        registry: Any = None,
         retry_after_s: float = 0.25,
-        clock=monotonic,
+        clock: Callable[[], float] = monotonic,
         autostart: bool = True,
-        lane=None,
-        profile=None,
-    ):
+        lane: Any = None,
+        profile: Callable[[float], None] | None = None,
+    ) -> None:
         self.engine = engine
         self.name = name
         self.metrics = metrics
@@ -230,13 +234,13 @@ class SlotScheduler:
 
     def submit(
         self,
-        prompt,
+        prompt: Iterable[int],
         *,
         max_new_tokens: int,
         temperature: float = 0.0,
         eos_id: int | None = None,
         request_id: str | None = None,
-        deadline=None,
+        deadline: Any = None,
     ) -> GenStream:
         """Admit one generation request; returns its stream immediately.
         Sheds with a typed ``Overloaded`` when the slot table (plus the
@@ -281,7 +285,7 @@ class SlotScheduler:
             self._cv.notify_all()
         return stream
 
-    def _shed(self, why: str):
+    def _shed(self, why: str) -> NoReturn:
         self.sheds += 1
         if self.metrics is not None:
             self.metrics.inc("shed")
@@ -472,7 +476,7 @@ class SlotScheduler:
             return 0.0
         return self.tokens_streamed / dt
 
-    def summary(self) -> dict:
+    def summary(self) -> dict[str, Any]:
         return {
             "requests": self.requests,
             "sheds": self.sheds,

@@ -29,7 +29,11 @@ import logging
 import os
 import threading
 import time
-from typing import Iterator
+from collections.abc import Callable, Iterable
+from typing import TYPE_CHECKING, Any, Iterator
+
+if TYPE_CHECKING:
+    from dmlc_tpu.generate.slots import GenStream, SlotScheduler
 
 from dmlc_tpu.cluster.rpc import RpcError
 from dmlc_tpu.utils.tracing import traced_methods, tracer
@@ -52,12 +56,12 @@ class GenerationBackend:
         max_prefill: int = 64,
         max_waiting: int = 0,
         use_pallas: bool | None = None,
-        metrics=None,
-        flight=None,
-        registry=None,
-        lane=None,
-        profile=None,
-    ):
+        metrics: Any = None,
+        flight: Any = None,
+        registry: Any = None,
+        lane: Any = None,
+        profile: Callable[[float], None] | None = None,
+    ) -> None:
         self.model_name = model_name
         self.max_slots = int(max_slots)
         self.page_size = int(page_size)
@@ -70,7 +74,7 @@ class GenerationBackend:
         self.registry = registry
         self.lane = lane
         self.profile = profile
-        self._scheduler = None
+        self._scheduler: SlotScheduler | None = None
         self._lock = threading.Lock()
 
     def warmup(self) -> None:
@@ -78,8 +82,10 @@ class GenerationBackend:
         GIL-starvation rationale as EngineBackend.warmup)."""
         self._ensure()
 
-    def _ensure(self):
-        # dmlc-lint: disable=A2 -- one-time lazy init: requests arriving before the engine exists must block on the single build, not double-build it (EngineBackend's pattern)
+    def _ensure(self) -> SlotScheduler:
+        # One-time lazy init: requests arriving before the engine exists must
+        # block on the single build, not double-build it (EngineBackend's
+        # pattern).
         with self._lock:
             if self._scheduler is None:
                 from dmlc_tpu.generate.engine import GenerationEngine
@@ -105,14 +111,14 @@ class GenerationBackend:
                 )
             return self._scheduler
 
-    def submit(self, prompt, **kw):
+    def submit(self, prompt: Iterable[int], **kw: Any) -> GenStream:
         return self._ensure().submit(prompt, **kw)
 
-    def load_variables(self, variables) -> None:
+    def load_variables(self, variables: Any) -> None:
         """`train`-verb hot-swap into the live engine."""
         self._ensure().engine.load_variables(variables)
 
-    def summary(self) -> dict:
+    def summary(self) -> dict[str, Any]:
         with self._lock:
             sched = self._scheduler
         return sched.summary() if sched is not None else {"built": False}
@@ -127,7 +133,7 @@ class GenerationBackend:
 class _Session:
     __slots__ = ("stream", "last_poll")
 
-    def __init__(self, stream, now: float):
+    def __init__(self, stream: GenStream, now: float) -> None:
         self.stream = stream
         self.last_poll = now
 
@@ -135,15 +141,16 @@ class _Session:
 class GenerateWorker:
     """RPC surface over a dict of GenerationBackends."""
 
-    def __init__(self, backends: dict, *, session_ttl_s: float = 120.0,
-                 clock=time.monotonic):
+    def __init__(self, backends: dict[str, GenerationBackend], *,
+                 session_ttl_s: float = 120.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
         self.backends = dict(backends)
         self.session_ttl_s = float(session_ttl_s)
         self.clock = clock
         self._sessions: dict[str, _Session] = {}
         self._lock = threading.Lock()
 
-    def methods(self) -> dict:
+    def methods(self) -> dict[str, Any]:
         return traced_methods({
             "job.generate": self._generate,
             "job.generate_poll": self._poll,
@@ -158,7 +165,7 @@ class GenerateWorker:
             )
         return backend
 
-    def _generate(self, p: dict) -> dict:
+    def _generate(self, p: dict[str, Any]) -> dict[str, Any]:
         backend = self._backend(p["model"])
         gen_id = os.urandom(8).hex()
         try:
@@ -177,7 +184,7 @@ class GenerateWorker:
             self._sessions[gen_id] = _Session(stream, now)
         return {"gen_id": gen_id, "model": p["model"]}
 
-    def _poll(self, p: dict) -> dict:
+    def _poll(self, p: dict[str, Any]) -> dict[str, Any]:
         gen_id = p["gen_id"]
         now = self.clock()
         with self._lock:
@@ -192,7 +199,7 @@ class GenerateWorker:
         # cancel) reap it instead.
         return session.stream.chunks_after(int(p.get("ack", 0)))
 
-    def _cancel(self, p: dict) -> dict:
+    def _cancel(self, p: dict[str, Any]) -> dict[str, Any]:
         with self._lock:
             session = self._sessions.pop(p["gen_id"], None)
         # The slots remain driven to completion (mid-step cancellation is a
@@ -210,7 +217,7 @@ class GenerateWorker:
         if dead:
             log.info("swept %d abandoned generation session(s)", len(dead))
 
-    def summary(self) -> dict:
+    def summary(self) -> dict[str, Any]:
         with self._lock:
             open_sessions = len(self._sessions)
         return {
@@ -225,17 +232,17 @@ class GenerateWorker:
 
 
 def generate_stream(
-    rpc,
+    rpc: Any,
     addr: str,
     model: str,
-    prompt,
+    prompt: Iterable[int],
     *,
     max_new_tokens: int,
     temperature: float = 0.0,
     eos_id: int | None = None,
     poll_timeout: float = 10.0,
     poll_interval_s: float = 0.0,
-    sleep=time.sleep,
+    sleep: Callable[[float], None] = time.sleep,
 ) -> Iterator[int]:
     """Submit and yield tokens as they stream. Exactly-once: chunks are
     dedup'd by seq and acked cumulatively, so a retried poll after a lost
@@ -274,6 +281,7 @@ def generate_stream(
                 sleep(poll_interval_s)
 
 
-def generate(rpc, addr, model, prompt, **kw) -> list[int]:
+def generate(rpc: Any, addr: str, model: str, prompt: Iterable[int],
+             **kw: Any) -> list[int]:
     """Blocking convenience: the full generated token list."""
     return list(generate_stream(rpc, addr, model, prompt, **kw))

@@ -440,6 +440,7 @@ class InferenceEngine:
         that the host, not the device, is the bottleneck. (run_batch records
         true per-batch device latency into latency_summary.)"""
         t0 = time.perf_counter()
+        # dmlc-lint: disable=A7 -- designed sync: _materialize IS the stream pipeline's two-behind backpressure barrier, and the wait is measured and exported as device/sync_wait rather than hidden
         out = jax.block_until_ready(out)
         dt = time.perf_counter() - t0
         with self._ingest_lock:

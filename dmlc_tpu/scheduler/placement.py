@@ -40,7 +40,7 @@ import logging
 import threading
 from dataclasses import dataclass, field
 from time import monotonic
-from typing import Callable
+from typing import Any, Callable
 
 log = logging.getLogger(__name__)
 
@@ -88,7 +88,7 @@ class SloEvaluator:
 
     def __init__(
         self,
-        profiler,
+        profiler: Any,
         objectives: dict[str, SloObjective],
         *,
         fast_window_s: float = 300.0,
@@ -96,11 +96,11 @@ class SloEvaluator:
         fast_burn: float = 14.0,
         slow_burn: float = 2.0,
         stage: str = "dispatch",
-        metrics=None,
-        flight=None,
-        registry=None,
+        metrics: Any = None,
+        flight: Any = None,
+        registry: Any = None,
         on_fast_burn: Callable[[str], None] | None = None,
-    ):
+    ) -> None:
         self.profiler = profiler
         self.objectives = dict(objectives)
         self.fast_window_s = float(fast_window_s)
@@ -240,10 +240,10 @@ class PlacementAdvisor:
 
     def __init__(
         self,
-        profiler,
+        profiler: Any,
         *,
-        flight=None,
-        metrics=None,
+        flight: Any = None,
+        metrics: Any = None,
         clock: Callable[[], float] = monotonic,
         max_moves: int = 2,
         window_s: float = 60.0,
@@ -253,7 +253,7 @@ class PlacementAdvisor:
         decode_idle: Callable[[str], float | None] | None = None,
         blob_locality: Callable[[str], float | None] | None = None,
         ingest_bias: float = 0.3,
-    ):
+    ) -> None:
         self.profiler = profiler
         self.flight = flight
         self.metrics = metrics

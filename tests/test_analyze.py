@@ -429,6 +429,298 @@ def test_a4_hard_read_of_never_produced_field(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# A5 — donation-after-use
+# ---------------------------------------------------------------------------
+
+_A5_ENGINE = """
+    import jax
+
+
+    class Engine:
+        def __init__(self):
+            self._step = self._build()
+
+        def _build(self):
+            def step(state, x):
+                return state + x
+            return jax.jit(step, donate_argnums=(0,))
+
+        def run(self, state, x):
+            out = self._step(state, x)
+            return state.sum()
+"""
+
+
+def test_a5_donated_buffer_read_after_call_with_witness(tmp_path):
+    findings = analyze(tmp_path, "fxa5", {"eng.py": _A5_ENGINE})
+    a5 = [f for f in findings if f.rule == "A5"]
+    assert len(a5) == 1, [f.message for f in findings]
+    f = a5[0]
+    # Anchored at the donating call, naming the donated value and argnum.
+    assert f.path == "fxa5/eng.py"
+    assert "state" in f.message and "donated" in f.message
+    assert "argnum 0" in f.message
+    # The witness ends at the read site (the `state.sum()` line).
+    assert f.chain, "A5 findings carry a witness chain"
+    read_line = next(
+        i + 1 for i, ln in enumerate(_A5_ENGINE.splitlines())
+        if "state.sum()" in ln
+    )
+    assert f.chain[-1].line == read_line
+
+
+def test_a5_rebinding_through_the_donating_call_is_clean(tmp_path):
+    # The canonical `state = step(state, ...)` pattern: the donating
+    # statement's own target rebinds the name, so nothing stale survives.
+    clean = _A5_ENGINE.replace(
+        "out = self._step(state, x)\n            return state.sum()",
+        "state = self._step(state, x)\n            return state.sum()",
+    )
+    findings = analyze(tmp_path, "fxa5", {"eng.py": clean})
+    assert [f for f in findings if f.rule == "A5"] == []
+
+
+def test_a5_interprocedural_reassign_kill_is_clean(tmp_path):
+    # engine.py's real shape: the donated pools are re-bound by a helper
+    # method called after the donating dispatch.
+    src = """
+        import jax
+
+
+        class Engine:
+            def __init__(self):
+                self._step = self._build()
+
+            def _build(self):
+                def step(k, x):
+                    return k * x
+                return jax.jit(step, donate_argnums=(0,))
+
+            def tick(self, x):
+                k = self._step(self._k, x)
+                self._install(k)
+                return self._k
+
+            def _install(self, k):
+                self._k = k
+    """
+    findings = analyze(tmp_path, "fxa5b", {"eng.py": src})
+    assert [f for f in findings if f.rule == "A5"] == []
+
+
+def test_a5_suppression_on_the_donating_call_line(tmp_path):
+    files = {"eng.py": _A5_ENGINE.replace(
+        "out = self._step(state, x)",
+        "out = self._step(state, x)  # dmlc-lint: disable=A5 -- fixture: "
+        "state is host-resident here by design",
+    )}
+    findings = analyze(tmp_path, "fxa5", files)
+    assert [f for f in findings if f.rule == "A5"] == []
+    # The suppression is USED, so no S2 stale finding either.
+    assert [f for f in findings if f.rule == "S2"] == []
+
+
+# ---------------------------------------------------------------------------
+# A6 — recompile hazards (signature census)
+# ---------------------------------------------------------------------------
+
+_A6_BOUNDED = """
+    from functools import partial
+
+    import jax
+
+
+    @partial(jax.jit, static_argnums=(1,))
+    def run(x, mode):
+        return x
+
+
+    def fwd(x):
+        return run(x, 0)
+
+
+    def bwd(x):
+        return run(x, 1)
+"""
+
+
+def test_a6_two_static_signatures_are_clean(tmp_path):
+    findings = analyze(tmp_path, "fxa6", {"m.py": _A6_BOUNDED})
+    assert [f for f in findings if f.rule == "A6"] == [], \
+        [f.message for f in findings]
+
+
+def test_a6_loop_variable_at_static_position_is_unbounded(tmp_path):
+    src = _A6_BOUNDED + """
+
+    def sweep(x):
+        for n in range(64):
+            run(x, n)
+"""
+    findings = analyze(tmp_path, "fxa6", {"m.py": src})
+    a6 = [f for f in findings if f.rule == "A6"]
+    assert len(a6) == 1, [f.message for f in findings]
+    assert "unbounded" in a6[0].message
+    assert a6[0].chain, "A6 unbounded findings point back at the jit"
+
+
+# ---------------------------------------------------------------------------
+# A7 — host sync reachable from a hot path
+# ---------------------------------------------------------------------------
+
+_A7_FILES = {
+    "front.py": """
+        from fxa7.mid import relay
+
+
+        def serve_hot(x):
+            return relay(x)
+    """,
+    "mid.py": """
+        from fxa7.sink import materialize
+
+
+        def relay(x):
+            return materialize(x)
+    """,
+    "sink.py": """
+        import jax
+
+
+        def materialize(x):
+            return jax.device_get(x)
+    """,
+}
+
+
+def test_a7_sync_three_modules_from_hot_path(tmp_path):
+    findings = analyze(tmp_path, "fxa7", _A7_FILES)
+    a7 = [f for f in findings if f.rule == "A7"]
+    assert len(a7) == 1, [f.message for f in findings]
+    f = a7[0]
+    # Anchored at the sync itself, naming the hot entry point it stalls.
+    assert f.path == "fxa7/sink.py"
+    assert "serve_hot" in f.message
+    chain_text = " ".join(s.render() for s in f.chain)
+    assert "fxa7/mid.py" in chain_text
+
+
+def test_a7_sync_outside_hot_reachability_is_clean(tmp_path):
+    files = dict(_A7_FILES)
+    files["front.py"] = files["front.py"].replace("serve_hot", "serve_cold")
+    findings = analyze(tmp_path, "fxa7", files)
+    assert [f for f in findings if f.rule == "A7"] == []
+
+
+# ---------------------------------------------------------------------------
+# A8 — mesh / PartitionSpec consistency
+# ---------------------------------------------------------------------------
+
+
+def test_a8_undeclared_axis_in_shard_map_spec(tmp_path):
+    src = """
+        from jax.sharding import Mesh, PartitionSpec
+        from jax.experimental.shard_map import shard_map
+
+
+        def build(devs, fn):
+            mesh = Mesh(devs, axis_names=("dp", "tp"))
+            return shard_map(fn, mesh=mesh, in_specs=(PartitionSpec("dp"),),
+                             out_specs=PartitionSpec("mp"))
+    """
+    findings = analyze(tmp_path, "fxa8", {"m.py": src})
+    a8 = [f for f in findings if f.rule == "A8"]
+    assert len(a8) == 1, [f.message for f in findings]
+    assert "'mp'" in a8[0].message
+    assert "dp" in a8[0].message and "tp" in a8[0].message  # declared axes
+    chain_text = " ".join(s.render() for s in a8[0].chain)
+    assert "mesh" in chain_text.lower()
+
+
+def test_a8_rank_mismatched_partition_spec(tmp_path):
+    src = """
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec
+        from jax.experimental.shard_map import shard_map
+
+
+        def run(devs, fn):
+            mesh = Mesh(devs, axis_names=("dp",))
+            x = jnp.zeros((4, 8))
+            return shard_map(fn, mesh=mesh,
+                             in_specs=(PartitionSpec("dp", None, None),),
+                             out_specs=PartitionSpec("dp"))(x)
+    """
+    findings = analyze(tmp_path, "fxa8r", {"m.py": src})
+    a8 = [f for f in findings if f.rule == "A8"]
+    assert len(a8) == 1, [f.message for f in findings]
+    assert "rank" in a8[0].message
+
+
+def test_a8_declared_axes_and_matching_rank_are_clean(tmp_path):
+    src = """
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec
+        from jax.experimental.shard_map import shard_map
+
+
+        def run(devs, fn):
+            mesh = Mesh(devs, axis_names=("dp", "tp"))
+            x = jnp.zeros((4, 8))
+            return shard_map(fn, mesh=mesh,
+                             in_specs=(PartitionSpec("dp", "tp"),),
+                             out_specs=PartitionSpec("dp"))(x)
+    """
+    findings = analyze(tmp_path, "fxa8c", {"m.py": src})
+    assert [f for f in findings if f.rule == "A8"] == [], \
+        [f.message for f in findings]
+
+
+def test_a8_parameter_mesh_stays_silent(tmp_path):
+    # The under-approximation contract: a mesh that arrives as a parameter
+    # has unknown axes, so nothing is provable and nothing fires.
+    src = """
+        from jax.sharding import PartitionSpec
+        from jax.experimental.shard_map import shard_map
+
+
+        def build(mesh, fn, axis):
+            return shard_map(fn, mesh=mesh, in_specs=(PartitionSpec(axis),),
+                             out_specs=PartitionSpec("anything"))
+    """
+    findings = analyze(tmp_path, "fxa8p", {"m.py": src})
+    assert [f for f in findings if f.rule == "A8"] == []
+
+
+# ---------------------------------------------------------------------------
+# S2 — stale suppressions (analyzer-owned A-rules)
+# ---------------------------------------------------------------------------
+
+
+def test_s2_stale_a_rule_suppression_fires(tmp_path):
+    src = """
+        def quiet():
+            return 1  # dmlc-lint: disable=A7 -- nothing here ever synced
+    """
+    findings = analyze(tmp_path, "fxs2", {"m.py": src})
+    s2 = [f for f in findings if f.rule == "S2"]
+    assert len(s2) == 1, [f.message for f in findings]
+    assert "A7" in s2[0].message and "stale" in s2[0].message
+
+
+def test_s2_used_suppression_is_not_stale(tmp_path):
+    files = dict(_A7_FILES)
+    files["sink.py"] = files["sink.py"].replace(
+        "return jax.device_get(x)",
+        "return jax.device_get(x)  # dmlc-lint: disable=A7 -- fixture: "
+        "the readback IS the product here",
+    )
+    findings = analyze(tmp_path, "fxa7", files)
+    assert [f for f in findings if f.rule in ("A7", "S2")] == [], \
+        [f.message for f in findings]
+
+
+# ---------------------------------------------------------------------------
 # shared JSON schema + the real tree
 # ---------------------------------------------------------------------------
 
@@ -476,6 +768,25 @@ def test_cli_exits_nonzero_per_seeded_fixture(tmp_path):
             def ping(sock):
                 _send_frame(sock, {"m": "ping", "dd": 1.0})
         """}, "A4"),
+        "fxa5": ({"eng.py": _A5_ENGINE}, "A5"),
+        "fxa6": ({"m.py": _A6_BOUNDED + """
+
+    def sweep(x):
+        for n in range(64):
+            run(x, n)
+"""}, "A6"),
+        "fxa7": (_A7_FILES, "A7"),
+        "fxa8": ({"m.py": """
+            from jax.sharding import Mesh, PartitionSpec
+            from jax.experimental.shard_map import shard_map
+
+
+            def build(devs, fn):
+                mesh = Mesh(devs, axis_names=("dp", "tp"))
+                return shard_map(fn, mesh=mesh,
+                                 in_specs=(PartitionSpec("dp"),),
+                                 out_specs=PartitionSpec("mp"))
+        """}, "A8"),
     }
     for name, (files, rule) in seeds.items():
         pkg = write_pkg(tmp_path / name, name, files)
@@ -518,5 +829,66 @@ def test_list_rules():
         cwd=REPO, capture_output=True, text=True, timeout=60,
     )
     assert r.returncode == 0
-    for rule_id in ("A1", "A2", "A3", "A4"):
+    for rule_id in ("A1", "A2", "A3", "A4", "A5", "A6", "A7", "A8", "S2"):
         assert rule_id in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# the CI findings ratchet (tools/ratchet.py)
+# ---------------------------------------------------------------------------
+
+
+def _ratchet(pkg, baseline, *extra):
+    from tools.ratchet import main
+    return main(["--package", str(pkg), "--lint-paths", str(pkg),
+                 "--baseline", str(baseline), *extra])
+
+
+def test_ratchet_lifecycle(tmp_path, capsys):
+    """missing baseline -> update grandfathers the defect -> clean gate ->
+    a NEW finding fails -> fixing a grandfathered one only warns."""
+    pkg = write_pkg(tmp_path / "tree", "fxa7", _A7_FILES)
+    baseline = tmp_path / "baseline.json"
+
+    assert _ratchet(pkg, baseline) == 2  # no baseline yet
+    assert "tools.ratchet --update" in capsys.readouterr().err
+
+    assert _ratchet(pkg, baseline, "--update") == 0
+    entries = json.loads(baseline.read_text())["findings"]
+    assert any(e["rule"] == "A7" for e in entries)
+
+    assert _ratchet(pkg, baseline) == 0  # grandfathered == green
+    assert "grandfathered" in capsys.readouterr().out
+
+    # A new defect (A5 donation-after-use) is NOT in the baseline: gate fails.
+    (pkg / "eng.py").write_text(textwrap.dedent(_A5_ENGINE))
+    assert _ratchet(pkg, baseline) == 1
+    assert "not in baseline" in capsys.readouterr().out
+
+    # Fix everything: stale baseline entries warn (with the shrink command)
+    # but never fail the gate.
+    (pkg / "eng.py").unlink()
+    (pkg / "sink.py").write_text(textwrap.dedent(_A7_FILES["sink.py"]).replace(
+        "return jax.device_get(x)", "return x"))
+    assert _ratchet(pkg, baseline) == 0
+    out = capsys.readouterr().out
+    assert "WARNING" in out and "--update" in out
+
+
+def test_ratchet_accepts_committed_repo_baseline():
+    """The committed baseline + the real tree = green gate (what
+    tools/ci_check.sh step 1 runs)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.ratchet"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, f"rc={r.returncode}\n{r.stdout}\n{r.stderr}"
+
+
+def test_analyzer_runtime_budget():
+    """A1-A8 over the whole tree stays inside the 2s interactive budget
+    (pure AST, no imports — docs/ANALYZE.md)."""
+    import time
+    t0 = time.monotonic()
+    run_rules(REPO / "dmlc_tpu")
+    assert time.monotonic() - t0 < 2.0

@@ -569,13 +569,15 @@ def test_s1_fires_on_unjustified_suppression():
 
 
 def test_suppression_only_covers_named_rules():
+    # The misnamed E1 does not hide D1 — and since E1 never fires on the
+    # line, the suppression is also stale (S2).
     src = """
     import time
 
     def f():
         return time.time()  # dmlc-lint: disable=E1 -- wrong rule named
     """
-    assert fired(src, "dmlc_tpu/cluster/x.py") == ["D1"]
+    assert sorted(fired(src, "dmlc_tpu/cluster/x.py")) == ["D1", "S2"]
 
 
 def test_suppression_in_string_literal_is_inert():
@@ -586,6 +588,66 @@ def test_suppression_in_string_literal_is_inert():
     t = time.time()
     '''
     assert fired(src, "dmlc_tpu/cluster/x.py") == ["D1"]
+
+
+# ---------------------------------------------------------------------------
+# S2 — stale suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_s2_fires_on_stale_suppression():
+    src = """
+    import time
+
+    def f(clock):
+        return clock.now()  # dmlc-lint: disable=D1 -- leftover after a fix
+    """
+    out = fired(src, "dmlc_tpu/cluster/x.py")
+    assert out == ["S2"], out
+
+
+def test_s2_silent_on_used_suppression():
+    src = """
+    import time
+
+    t = time.time()  # dmlc-lint: disable=D1 -- harness measures wall time
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == []
+
+
+def test_s2_names_only_the_stale_rule_in_a_multi_rule_comment():
+    # D1 fires (and is covered); F1 never does — S2 points at F1 alone.
+    src = """
+    import time
+
+    t = time.time()  # dmlc-lint: disable=D1,F1 -- clock is real here
+    """
+    findings = lint_source(textwrap.dedent(src), "dmlc_tpu/cluster/x.py")
+    assert [f.rule for f in findings] == ["S2"]
+    assert "F1" in findings[0].message and "D1" not in findings[0].message
+
+
+def test_s2_ignores_analyzer_owned_rules():
+    # A-rule staleness belongs to dmlc-analyze (whole-program view); the
+    # file-local pass must not call cross-module suppressions stale.
+    src = """
+    def f(x):
+        return x  # dmlc-lint: disable=A7 -- analyze-owned, lint can't tell
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == []
+
+
+def test_s2_same_line_suppression_beats_previous_line_spillover():
+    # Two consecutive lines, each with its own trailing suppression: the
+    # second line's finding must consume the SECOND comment, not the first
+    # line's next-line spillover — otherwise the second comment reads stale.
+    src = """
+    import time
+
+    a = time.time()  # dmlc-lint: disable=D1 -- first real clock read
+    b = time.time()  # dmlc-lint: disable=D1 -- second real clock read
+    """
+    assert fired(src, "dmlc_tpu/cluster/x.py") == []
 
 
 # ---------------------------------------------------------------------------
@@ -913,7 +975,7 @@ def test_cli_lists_all_rules_and_exits_nonzero_on_findings(tmp_path):
     )
     assert r.returncode == 0
     for rule_id in ("D1", "J1", "J2", "J3", "L1", "E1", "H1", "F1", "R1", "O1",
-                    "O2", "S1"):
+                    "O2", "S1", "S2"):
         assert rule_id in r.stdout
     bad = tmp_path / "dmlc_tpu" / "cluster"
     bad.mkdir(parents=True)

@@ -21,9 +21,15 @@ import sys
 from dataclasses import dataclass, field
 from pathlib import Path
 
+import re
+
 from tools.lint.core import _apply_suppressions, _collect_suppressions
 from tools.lint.core import Finding as LintFinding
 from tools.analyze.project import Project, Step
+
+#: Rules this analyzer owns; their suppression staleness (S2) is checked
+#: here, not by dmlc-lint.
+_A_RULE_RE = re.compile(r"A\d+$")
 
 
 @dataclass
@@ -84,28 +90,46 @@ def run_rules(package_dir: str | Path) -> Analysis:
 
 def _suppress(analysis: Analysis) -> list[Finding]:
     """Apply ``# dmlc-lint: disable=Ax`` comments file by file, reusing the
-    lint core's tokenizer-based collection and line semantics."""
+    lint core's tokenizer-based collection and line semantics.
+
+    Staleness is split by ownership: an A-rule suppression that suppressed
+    nothing here becomes an S2 finding (dmlc-lint skips A-rules in its own
+    S2 pass, because only the analyzer knows whether one still fires)."""
     by_path: dict[str, list[Finding]] = {}
     for f in analysis.findings:
         by_path.setdefault(f.path, []).append(f)
-    src_by_path = {m.relpath: m.src for m in analysis.project.modules.values()}
     kept: list[Finding] = []
-    for path, findings in by_path.items():
-        src = src_by_path.get(path)
-        if src is None:
-            kept.extend(findings)
-            continue
-        sups = _collect_suppressions(src)
-        # Reuse lint's application logic through its Finding shape, then map
-        # the survivors back (path+line+rule+message is unique enough here).
-        proxies = [
-            LintFinding(path, f.line, f.col, f.rule, f.message) for f in findings
-        ]
-        surviving = _apply_suppressions(proxies, sups)
-        alive = {(p.line, p.col, p.rule, p.message) for p in surviving}
-        kept.extend(
-            f for f in findings if (f.line, f.col, f.rule, f.message) in alive
-        )
+    for mod in analysis.project.modules.values():
+        path = mod.relpath
+        sups = _collect_suppressions(mod.src)
+        findings = by_path.pop(path, [])
+        if findings:
+            # Reuse lint's application logic through its Finding shape, then
+            # map survivors back (path+line+rule+message is unique enough).
+            proxies = [
+                LintFinding(path, f.line, f.col, f.rule, f.message)
+                for f in findings
+            ]
+            surviving = _apply_suppressions(proxies, sups)
+            alive = {(p.line, p.col, p.rule, p.message) for p in surviving}
+            kept.extend(
+                f for f in findings
+                if (f.line, f.col, f.rule, f.message) in alive
+            )
+        for s in sups:
+            for r in s.rules:
+                if r in s.used or not _A_RULE_RE.match(r):
+                    continue
+                kept.append(Finding(
+                    path, s.line, 0, "S2",
+                    f"stale suppression: {r} no longer fires on this line — "
+                    f"delete {r} from the comment (or the whole comment if "
+                    "nothing listed still fires)",
+                ))
+    # Findings in files outside the loaded module set (should not happen,
+    # but never silently drop a finding).
+    for findings in by_path.values():
+        kept.extend(findings)
     return kept
 
 
@@ -125,6 +149,10 @@ def _list_rules() -> str:
     for rule in RULES:
         lines.append(f"{rule.id}  {rule.summary}")
         lines.append(f"    fix: {rule.hint}")
+    lines.append("S2  an A-rule suppression that no longer suppresses "
+                 "anything is itself a finding")
+    lines.append("    fix: delete the stale rule id from the comment (or "
+                 "the whole comment)")
     return "\n".join(lines)
 
 

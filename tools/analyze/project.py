@@ -43,6 +43,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 
 from tools.lint.rules import ImportMap, dotted_name
+from tools.lint.rules.jit import _decorator_is_jit, _is_jit_name
 from tools.lint.rules.locks import _lock_name as lock_display_name
 
 MAX_DEPTH = 16  # call-graph traversal bound (protects against pathological fan-out)
@@ -606,4 +607,429 @@ class Project:
             dotted = ctx.module.imports.resolve(dotted_name(value))
             if dotted is not None:
                 return self._func_from_dotted(dotted, ctx.module)
+        return None
+
+    # ---- device-semantics model (rules A5-A8) ---------------------------
+
+    def device_model(self) -> "DeviceModel":
+        """The jit-wrapper / mesh-axis view of the project, built once per
+        run and shared by the A5-A8 rule family (docs/ANALYZE.md)."""
+        if getattr(self, "_device_model", None) is None:
+            self._device_model = DeviceModel(self)
+        return self._device_model
+
+
+# ---- device semantics: jit wrappers, mesh axes, hot entry points ---------
+#
+# Everything below models what the XLA runtime will *actually do* with the
+# code — which buffers a compiled program is allowed to invalidate
+# (donate_argnums), which call-site argument shapes key its compilation
+# cache, and which mesh axes a PartitionSpec or collective may legally
+# name. The same under-approximation contract as the rest of this module
+# applies: an edge/axis/donation is recorded only when it is statically
+# certain, so rule findings are real program behaviors, never guesses.
+
+_ARRAY_CTORS = {
+    "zeros", "ones", "full", "empty", "arange", "asarray", "array",
+    "broadcast_to", "linspace",
+}
+
+_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "axis_index", "all_gather",
+    "all_to_all", "ppermute",
+}
+
+
+@dataclass
+class JitWrapper:
+    """One compiled program: a function wrapped by jax.jit/pjit, however
+    the binding was spelled (decorator, local ``w = jax.jit(f)``, attribute
+    ``self._step = jax.jit(step)`` — including the builder-method idiom
+    ``self._step = self._build_step()`` whose builder returns the jit)."""
+
+    kind: str                       # "decorated" | "local" | "attr"
+    name: str                       # callable spelling at call sites
+    relpath: str
+    line: int                       # jit construction (or decorator) line
+    fn_node: ast.FunctionDef | ast.AsyncFunctionDef | None
+    owner: FuncDef | None           # function holding a local binding
+    cls_qname: str | None           # class owning an attr binding
+    target_fd: FuncDef | None       # project FuncDef when the wrapped fn has one
+    donate: set[int] = field(default_factory=set)
+    static: set[int] = field(default_factory=set)
+    static_names: set[str] = field(default_factory=set)
+
+    @property
+    def param_names(self) -> tuple[str, ...]:
+        if self.fn_node is None:
+            return ()
+        a = self.fn_node.args
+        return tuple(p.arg for p in [*a.posonlyargs, *a.args])
+
+    def self_offset(self, call: ast.Call) -> int:
+        """Positional-arg offset between call-site args and wrapped params
+        (1 for a jit-decorated method invoked as ``self.m(...)``)."""
+        if (self.target_fd is not None and self.target_fd.cls is not None
+                and self.kind == "decorated"):
+            f = call.func
+            if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                    and f.value.id == "self":
+                return 1
+        return 0
+
+
+@dataclass(frozen=True)
+class MeshDef:
+    axes: tuple[str, ...]
+    relpath: str
+    line: int
+
+
+def _literal_str_tuple(node) -> tuple[str, ...] | None:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    return None
+
+
+def _literal_int_set(node) -> set[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: set[int] = set()
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, int)):
+                return set()
+            out.add(e.value)
+        return out
+    return set()
+
+
+def _own_returns(fn_node) -> list[ast.Return]:
+    """``return <expr>`` statements of ``fn_node`` ITSELF — a builder whose
+    jitted target is a nested def must not count the target's returns."""
+    out: list[ast.Return] = []
+    stack = list(fn_node.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Return):
+            if node.value is not None:
+                out.append(node)
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def nested_defs(fn_node) -> dict[str, ast.FunctionDef]:
+    """Name -> def for functions nested (at any depth) inside ``fn_node``."""
+    out: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(fn_node):
+        if node is fn_node:
+            continue
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+    return out
+
+
+class DeviceModel:
+    """Jit wrappers + call sites, mesh axis environments, shard_map sites,
+    and hot-path entry points for one loaded Project."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.wrappers: list[JitWrapper] = []
+        #: (owner_qname, name) -> wrapper, for `w = jax.jit(f)` locals
+        self._local: dict[tuple[str, str], JitWrapper] = {}
+        #: (cls_qname, attr) -> wrapper, for `self.X = jax.jit(f)` attrs
+        self._attr: dict[tuple[str, str], JitWrapper] = {}
+        #: FuncDef qname -> wrapper, for decorated functions/methods
+        self._decorated: dict[str, JitWrapper] = {}
+        #: module var -> MeshDef with statically-known axis names
+        self.module_meshes: dict[tuple[str, str], MeshDef] = {}
+        #: (cls_qname, attr) -> MeshDef
+        self.attr_meshes: dict[tuple[str, str], MeshDef] = {}
+        self._build()
+        self._sites: dict[int, list[tuple[FuncDef, ast.Call]]] | None = None
+
+    # -- construction ------------------------------------------------------
+
+    def _build(self) -> None:
+        for mod in self.project.modules.values():
+            self._scan_module_meshes(mod)
+            for fd in self.project._all_funcs(mod):
+                self._scan_decorated(fd)
+                self._scan_bindings(fd)
+
+    def _scan_module_meshes(self, mod: ModuleInfo) -> None:
+        for node in mod.tree.body:
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            md = self.mesh_from_expr(node.value, mod, None)
+            if md is not None:
+                self.module_meshes[(mod.name, node.targets[0].id)] = md
+
+    def _scan_decorated(self, fd: FuncDef) -> None:
+        imports = fd.module.imports
+        for dec in fd.node.decorator_list:
+            if not _decorator_is_jit(dec, imports):
+                continue
+            w = JitWrapper(
+                "decorated", fd.name, fd.module.relpath, dec.lineno,
+                fd.node, None, fd.cls.qname if fd.cls else None, fd,
+            )
+            self._jit_kwargs(dec if isinstance(dec, ast.Call) else None, w)
+            self.wrappers.append(w)
+            self._decorated[fd.qname] = w
+            return
+
+    def _scan_bindings(self, fd: FuncDef) -> None:
+        """``w = jax.jit(f, ...)`` locals, ``self.X = jax.jit(f, ...)``
+        attrs, and the builder idiom ``self.X = self._build()`` where the
+        builder's single return is a jit call. Also nested defs decorated
+        with jit (they behave as local bindings of their own name)."""
+        imports = fd.module.imports
+        local_defs = nested_defs(fd.node)
+        for name, node in local_defs.items():
+            if any(_decorator_is_jit(d, imports) for d in node.decorator_list):
+                w = JitWrapper("local", name, fd.module.relpath,
+                               node.decorator_list[0].lineno, node, fd, None, None)
+                dec = next(d for d in node.decorator_list
+                           if _decorator_is_jit(d, imports))
+                self._jit_kwargs(dec if isinstance(dec, ast.Call) else None, w)
+                self.wrappers.append(w)
+                self._local[(fd.qname, name)] = w
+        for node in ast.walk(fd.node):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target, value = node.targets[0], node.value
+            jit_call = self._as_jit_call(value, fd, local_defs)
+            if isinstance(target, ast.Name):
+                if jit_call is not None:
+                    w = self._wrapper_from_jit_call(
+                        jit_call, "local", target.id, fd, local_defs)
+                    self._register_local(fd, target.id, w)
+                continue
+            if not Project._is_self_attr(target) or fd.cls is None:
+                continue
+            attr = target.attr
+            if jit_call is not None:
+                w = self._wrapper_from_jit_call(
+                    jit_call, "attr", f"self.{attr}", fd, local_defs)
+                self._register_attr(fd.cls, attr, w)
+                continue
+            md = self.mesh_from_expr(value, fd.module, fd)
+            if md is not None:
+                self.attr_meshes.setdefault((fd.cls.qname, attr), md)
+
+    def _as_jit_call(self, value, fd: FuncDef, local_defs) -> ast.Call | None:
+        """``value`` as a jit(...) construction: either directly, or a call
+        to a same-class builder method whose only return is one."""
+        if not isinstance(value, ast.Call):
+            return None
+        if _is_jit_name(value.func, fd.module.imports):
+            return value
+        if Project._is_self_attr(value.func) and fd.cls is not None:
+            builder = self.project.lookup_method(fd.cls, value.func.attr)
+            if builder is None:
+                return None
+            returns = _own_returns(builder.node)
+            if len(returns) == 1 and isinstance(returns[0].value, ast.Call) \
+                    and _is_jit_name(returns[0].value.func, builder.module.imports):
+                # Remember the builder so the wrapped nested def resolves in
+                # the builder's scope, not the assigning method's.
+                self._builder_ctx = builder
+                return returns[0].value
+        return None
+
+    def _wrapper_from_jit_call(self, call: ast.Call, kind: str, name: str,
+                               fd: FuncDef, local_defs) -> JitWrapper:
+        builder = getattr(self, "_builder_ctx", None)
+        self._builder_ctx = None
+        scope_fd = builder or fd
+        scope_defs = nested_defs(scope_fd.node) if builder else local_defs
+        fn_node, target_fd = None, None
+        if call.args and isinstance(call.args[0], ast.Name):
+            wrapped = call.args[0].id
+            fn_node = scope_defs.get(wrapped)
+            if fn_node is None:
+                dotted = scope_fd.module.imports.resolve(wrapped)
+                target_fd = (self.project._func_from_dotted(dotted, scope_fd.module)
+                             if dotted else None)
+                fn_node = target_fd.node if target_fd is not None else None
+        w = JitWrapper(
+            kind, name, scope_fd.module.relpath, call.lineno, fn_node,
+            fd if kind == "local" else None,
+            fd.cls.qname if (kind == "attr" and fd.cls) else None, target_fd,
+        )
+        self._jit_kwargs(call, w)
+        return w
+
+    def _register_local(self, fd: FuncDef, name: str, w: JitWrapper) -> None:
+        prev = self._local.get((fd.qname, name))
+        if prev is None:
+            self._local[(fd.qname, name)] = w
+            self.wrappers.append(w)
+        else:
+            prev.donate |= w.donate
+            prev.static |= w.static
+            prev.static_names |= w.static_names
+
+    def _register_attr(self, cls: ClassInfo, attr: str, w: JitWrapper) -> None:
+        """Several bindings of one attr (platform branches) merge: donation
+        holds on SOME real path, which is what A5 reports against."""
+        prev = self._attr.get((cls.qname, attr))
+        if prev is None:
+            self._attr[(cls.qname, attr)] = w
+            self.wrappers.append(w)
+        else:
+            prev.donate |= w.donate
+            prev.static |= w.static
+            prev.static_names |= w.static_names
+
+    def _jit_kwargs(self, call: ast.Call | None, w: JitWrapper) -> None:
+        if call is None:
+            return
+        names: dict[str, ast.expr] = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+        w.donate |= _literal_int_set(names.get("donate_argnums"))
+        w.static |= _literal_int_set(names.get("static_argnums"))
+        w.static_names |= set(_literal_str_tuple(names.get("static_argnames")) or ())
+        donate_names = _literal_str_tuple(names.get("donate_argnames")) or ()
+        params = w.param_names
+        for n in donate_names:
+            if n in params:
+                w.donate.add(params.index(n))
+        for n in tuple(w.static_names):
+            if n in params:
+                w.static.add(params.index(n))
+
+    # -- call sites --------------------------------------------------------
+
+    def call_sites(self, w: JitWrapper) -> list[tuple[FuncDef, ast.Call]]:
+        if self._sites is None:
+            self._sites = {id(x): [] for x in self.wrappers}
+            for mod in self.project.modules.values():
+                for fd in self.project._all_funcs(mod):
+                    for call in iter_calls(fd.node.body):
+                        hit = self.wrapper_for_call(call, fd)
+                        if hit is not None:
+                            self._sites[id(hit)].append((fd, call))
+        return self._sites.get(id(w), [])
+
+    def wrapper_for_call(self, call: ast.Call, ctx: FuncDef) -> JitWrapper | None:
+        func = call.func
+        if isinstance(func, ast.Name):
+            local = self._local.get((ctx.qname, func.id))
+            if local is not None:
+                return local
+            callee, _ = self.project.resolve_call(call, ctx)
+            if callee is not None:
+                return self._decorated.get(callee.qname)
+            return None
+        if Project._is_self_attr(func) and ctx.cls is not None:
+            hit = self._attr.get((ctx.cls.qname, func.attr))
+            if hit is not None:
+                return hit
+            callee, _ = self.project.resolve_call(call, ctx)
+            if callee is not None:
+                return self._decorated.get(callee.qname)
+        return None
+
+    # -- hot entry points (rule A7) ---------------------------------------
+
+    def hot_funcs(self) -> list[FuncDef]:
+        out = []
+        for mod in self.project.modules.values():
+            for fd in self.project._all_funcs(mod):
+                if fd.name.endswith("_hot"):
+                    out.append(fd)
+                    continue
+                for dec in fd.node.decorator_list:
+                    node = dec.func if isinstance(dec, ast.Call) else dec
+                    name = mod.imports.resolve_node(node) or ""
+                    if name.rsplit(".", 1)[-1] == "hot_path":
+                        out.append(fd)
+                        break
+        return out
+
+    def jit_body_lines(self, relpath: str) -> set[int]:
+        """Line numbers inside jit-wrapped function bodies of one file —
+        A7's precedence boundary with lint J1 (which owns syncs there)."""
+        out: set[int] = set()
+        for w in self.wrappers:
+            if w.relpath != relpath or w.fn_node is None:
+                continue
+            end = getattr(w.fn_node, "end_lineno", None)
+            if end is not None:
+                out.update(range(w.fn_node.lineno, end + 1))
+        return out
+
+    # -- mesh axis environments (rule A8) ---------------------------------
+
+    def mesh_from_expr(self, value, mod: ModuleInfo, fd: FuncDef | None) -> MeshDef | None:
+        """Statically-known axis names of a mesh-constructing expression:
+        ``Mesh(grid, axis_names=(...literals...))`` or
+        ``make_mesh({'dp': ..., ...})`` (dict-literal keys; no-arg form is
+        the documented all-devices single ``dp`` axis)."""
+        if not isinstance(value, ast.Call):
+            return None
+        dotted = mod.imports.resolve_node(value.func) or ""
+        last = dotted.rsplit(".", 1)[-1]
+        if last == "Mesh":
+            cand = next((kw.value for kw in value.keywords
+                         if kw.arg == "axis_names"), None)
+            if cand is None and len(value.args) >= 2:
+                cand = value.args[1]
+            axes = _literal_str_tuple(cand) if cand is not None else None
+            if axes:
+                return MeshDef(axes, mod.relpath, value.lineno)
+            return None
+        if last == "make_mesh":
+            if not value.args and not any(kw.arg == "axes" for kw in value.keywords):
+                return MeshDef(("dp",), mod.relpath, value.lineno)
+            cand = value.args[0] if value.args else next(
+                (kw.value for kw in value.keywords if kw.arg == "axes"), None)
+            if isinstance(cand, ast.Dict):
+                keys = []
+                for k in cand.keys:
+                    if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                        return None
+                    keys.append(k.value)
+                return MeshDef(tuple(keys), mod.relpath, value.lineno)
+            if cand is not None:
+                # jax.make_mesh(shape, axis_names) positional form
+                axes = _literal_str_tuple(value.args[1]) if len(value.args) >= 2 else None
+                if axes:
+                    return MeshDef(axes, mod.relpath, value.lineno)
+        return None
+
+    def resolve_mesh(self, expr, ctx: FuncDef) -> MeshDef | None:
+        """Axis names for a mesh expression at a use site: direct
+        construction, a local bound to one, ``self.X`` bound to one, or a
+        module-global mesh. Anything else (mesh passed as a parameter) is
+        unknown and keeps A8 silent — the under-approximation contract."""
+        md = self.mesh_from_expr(expr, ctx.module, ctx)
+        if md is not None:
+            return md
+        if isinstance(expr, ast.Name):
+            for node in ast.walk(ctx.node):
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and node.targets[0].id == expr.id):
+                    md = self.mesh_from_expr(node.value, ctx.module, ctx)
+                    if md is not None:
+                        return md
+            return self.module_meshes.get((ctx.module.name, expr.id))
+        if Project._is_self_attr(expr) and ctx.cls is not None:
+            return self.attr_meshes.get((ctx.cls.qname, expr.attr))
         return None

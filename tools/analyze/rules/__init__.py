@@ -8,11 +8,15 @@ call-chain witnesses.
 
 from __future__ import annotations
 
-from tools.analyze.rules import blocking, frameschema, lockorder, propagation
+from tools.analyze.rules import blocking, devsem, frameschema, lockorder, propagation
 
 RULES = [
     lockorder.A1,
     blocking.A2,
     propagation.A3,
     frameschema.A4,
+    devsem.A5,
+    devsem.A6,
+    devsem.A7,
+    devsem.A8,
 ]

@@ -17,11 +17,17 @@ import tokenize
 from dataclasses import dataclass, field
 from pathlib import Path
 
-#: ``# dmlc-lint: disable=D1,L1 -- justification`` — the justification
-#: (everything after ``--``) is mandatory; rule S1 enforces it.
+#: ``# dmlc-lint: disable=<RULE[,RULE...]> -- justification`` — the
+#: justification (everything after ``--``) is mandatory; rule S1 enforces
+#: it, and S2 flags entries that no longer suppress anything.
 _SUPPRESS_RE = re.compile(
     r"#\s*dmlc-lint:\s*disable=([A-Z]\d+(?:\s*,\s*[A-Z]\d+)*)\s*(?:--\s*(\S.*))?"
 )
+
+#: Rules owned by dmlc-analyze (whole-program). Lint applies their
+#: suppressions but leaves staleness (S2) to the analyzer, which is the
+#: only tool that knows whether an A-rule still fires on the line.
+_ANALYZE_RULE_RE = re.compile(r"A\d+$")
 
 DEFAULT_PATHS = ("dmlc_tpu", "tools", "tests")
 
@@ -78,8 +84,12 @@ def _apply_suppressions(
         by_line.setdefault(s.line + 1, []).append(s)
     kept = []
     for f in findings:
+        candidates = [s for s in by_line.get(f.line, ()) if f.rule in s.rules]
+        # A same-line (trailing) comment beats a previous line's spillover,
+        # so consecutive per-line suppressions each count as used (S2).
         hit = next(
-            (s for s in by_line.get(f.line, ()) if f.rule in s.rules), None
+            (s for s in candidates if s.line == f.line),
+            candidates[0] if candidates else None,
         )
         if hit is None:
             kept.append(f)
@@ -125,6 +135,15 @@ def lint_source(src: str, relpath: str) -> list[Finding]:
                 "suppression without a justification: append "
                 "'-- <why this invariant is safe to break here>'",
             ))
+        for r in s.rules:
+            if r in s.used or _ANALYZE_RULE_RE.match(r):
+                continue
+            findings.append(Finding(
+                relpath, s.line, 0, "S2",
+                f"stale suppression: {r} does not fire on this line — "
+                f"delete {r} from the comment (or the whole comment if "
+                "nothing listed still fires)",
+            ))
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return findings
 
@@ -154,6 +173,12 @@ def _list_rules() -> str:
     lines.append("    scope: everywhere")
     lines.append("    fix:   explain why the invariant is safe to break, or "
                  "remove the suppression")
+    lines.append("S2  a suppressed rule that no longer fires on its line is "
+                 "a stale suppression")
+    lines.append("    scope: everywhere (lint checks its own rules; "
+                 "dmlc-analyze checks A-rules)")
+    lines.append("    fix:   delete the stale rule id from the comment (or "
+                 "the whole comment)")
     return "\n".join(lines)
 
 

@@ -1,0 +1,123 @@
+"""CI findings ratchet: dmlc-lint + dmlc-analyze against a committed baseline.
+
+``python -m tools.ratchet`` runs both tools in-process and compares their
+findings to ``tools/analysis_baseline.json``:
+
+* a finding **not** in the baseline fails the gate — new code must be clean
+  or carry a justified suppression;
+* a baseline entry that **no longer fires** is a warning with the exact
+  ``--update`` command to shrink the baseline — the baseline only shrinks,
+  it never grows silently.
+
+Findings are keyed by ``(tool, path, rule, message)``; line and column are
+deliberately excluded so edits above a grandfathered finding do not churn
+the baseline, and the witness chain is excluded because it is derived.
+``--update`` rewrites the baseline from the current run (review the diff —
+a growing baseline is a design smell, see docs/ANALYZE.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+DEFAULT_BASELINE = "tools/analysis_baseline.json"
+
+Key = tuple[str, str, str, str]  # (tool, path, rule, message)
+
+
+def current_findings(package: str, lint_paths: list[str]) -> list[Key]:
+    from tools.analyze.core import run_rules
+    from tools.lint.core import run as lint_run
+
+    keys: list[Key] = []
+    for f in lint_run(lint_paths):
+        keys.append(("lint", f.path, f.rule, f.message))
+    for f in run_rules(package).findings:
+        keys.append(("analyze", f.path, f.rule, f.message))
+    return keys
+
+
+def load_baseline(path: Path) -> list[Key] | None:
+    if not path.is_file():
+        return None
+    doc = json.loads(path.read_text(encoding="utf-8"))
+    return [
+        (e["tool"], e["path"], e["rule"], e["message"])
+        for e in doc.get("findings", [])
+    ]
+
+
+def write_baseline(path: Path, keys: list[Key]) -> None:
+    doc = {
+        "_comment": (
+            "Grandfathered dmlc-lint/dmlc-analyze findings. The ratchet "
+            "(python -m tools.ratchet) fails CI on any finding not listed "
+            "here and warns when an entry stops firing; regenerate with "
+            "--update only to SHRINK it."
+        ),
+        "findings": [
+            {"tool": t, "path": p, "rule": r, "message": m}
+            for t, p, r, m in sorted(set(keys))
+        ],
+    }
+    path.write_text(json.dumps(doc, indent=2) + "\n", encoding="utf-8")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dmlc-ratchet",
+        description="Findings ratchet over dmlc-lint + dmlc-analyze "
+                    "(docs/ANALYZE.md).",
+    )
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE, metavar="FILE")
+    parser.add_argument("--package", default="dmlc_tpu",
+                        help="package dmlc-analyze runs over")
+    parser.add_argument("--lint-paths", nargs="*", default=None,
+                        help="paths dmlc-lint runs over (default: its own)")
+    parser.add_argument("--update", action="store_true",
+                        help="rewrite the baseline from the current run")
+    args = parser.parse_args(argv)
+
+    from tools.lint.core import DEFAULT_PATHS
+
+    lint_paths = args.lint_paths or list(DEFAULT_PATHS)
+    baseline_path = Path(args.baseline)
+    keys = current_findings(args.package, lint_paths)
+
+    if args.update:
+        write_baseline(baseline_path, keys)
+        print(f"dmlc-ratchet: baseline rewritten ({len(set(keys))} "
+              f"entr{'y' if len(set(keys)) == 1 else 'ies'}) -> "
+              f"{baseline_path}")
+        return 0
+
+    baseline = load_baseline(baseline_path)
+    if baseline is None:
+        print(f"dmlc-ratchet: no baseline at {baseline_path}; create one "
+              f"with: python -m tools.ratchet --update", file=sys.stderr)
+        return 2
+
+    have, allowed = set(keys), set(baseline)
+    new = sorted(have - allowed)
+    gone = sorted(allowed - have)
+    for t, p, r, m in gone:
+        print(f"dmlc-ratchet: WARNING: baseline entry no longer fires "
+              f"({t}: {p}: {r} {m}) — shrink it: "
+              f"python -m tools.ratchet --update")
+    for t, p, r, m in new:
+        print(f"{p}: {r} {m}  [{t}, not in baseline]")
+    if new:
+        print(f"dmlc-ratchet: {len(new)} finding(s) not in the baseline — "
+              f"fix them or suppress with justification "
+              f"('# dmlc-lint: disable=<RULE> -- why')", file=sys.stderr)
+        return 1
+    print(f"dmlc-ratchet: OK ({len(have)} finding(s), all grandfathered)"
+          if have else "dmlc-ratchet: OK (no findings)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
