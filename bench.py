@@ -75,6 +75,65 @@ def _flops_per_image(engine) -> float | None:
         return None
 
 
+class _DeviceLegs:
+    """Per-leg device-plane capture for ``bench_detail.json["device"]``.
+
+    The engines' CensusedJit wrappers (cluster/devicemon.py) feed the
+    process-global compile census during every leg; bracketing each bench
+    section with begin/end turns that into per-leg compile counts and
+    compile-seconds, plus the HBM high-water mark, so a compile-time or
+    memory regression lands in the committed artifact NEXT TO the rates it
+    taxed instead of being inferred from wall-clock forensics."""
+
+    def __init__(self) -> None:
+        from dmlc_tpu.cluster.devicemon import CENSUS, DeviceMonitor
+
+        self._census = CENSUS
+        # No registry: this monitor exists for memory_stats()/peak_flops()
+        # reads only (both are graceful-None/CPU-default without jax).
+        self._monitor = DeviceMonitor(None)
+        self._open: dict[str, tuple[int, float, int, float]] = {}
+        self.legs: dict[str, dict] = {}
+
+    def begin(self, name: str) -> None:
+        self._open[name] = (
+            self._census.compiles(),
+            self._census.compile_seconds(),
+            self._census.steady_recompiles(),
+            time.monotonic(),
+        )
+
+    def end(self, name: str) -> None:
+        start = self._open.pop(name, None)
+        if start is None:
+            return
+        c0, s0, r0, t0 = start
+        stats = self._monitor.memory_stats() or {}
+        self.legs[name] = {
+            "wall_s": round(time.monotonic() - t0, 3),
+            "compiles": self._census.compiles() - c0,
+            "compile_seconds": round(self._census.compile_seconds() - s0, 3),
+            "steady_recompiles": self._census.steady_recompiles() - r0,
+            "peak_hbm_bytes": stats.get("peak_bytes_in_use"),
+            "hbm_limit_bytes": stats.get("bytes_limit"),
+        }
+
+    def section(self, results: list[dict]) -> dict:
+        """The artifact section: per-leg deltas, this run's measured MFU per
+        config against the platform roofline, and the per-label census for
+        attribution (which program paid the compiles)."""
+        return {
+            "peak_flops": self._monitor.peak_flops(),
+            "mfu": {
+                f"{r['model']}@{r['batch_size']}": r["mfu"]
+                for r in results
+                if r.get("mfu") is not None
+            },
+            "legs": self.legs,
+            "census": self._census.snapshot(),
+        }
+
+
 def _time_left(deadline: float | None) -> float:
     """Seconds until a ``time.monotonic()`` deadline; +inf when uncapped.
     The single definition of deadline semantics for every bench section."""
@@ -440,6 +499,15 @@ def merge_detail(new: dict, old: dict) -> dict:
                 continue
             merged[k] = v
         out[key] = merged if merged else (new.get(key) or {})
+
+    # device: a whole-run delta ledger (per-leg compile census + HBM
+    # watermark), so a fresh capture replaces the section wholesale; a run
+    # that produced none keeps the previous one stamped stale.
+    new_dev, old_dev = new.get("device"), old.get("device")
+    if new_dev:
+        out["device"] = new_dev
+    elif old_dev:
+        out["device"] = dict(old_dev, stale=True)
 
     out["history_best"] = update_history_best(
         old.get("history_best") or {}, list(new_configs) + curve_fresh
@@ -1313,6 +1381,7 @@ def main() -> None:
     args = parser.parse_args()
     t_start = time.monotonic()
     _enable_compile_cache()
+    devlegs = _DeviceLegs()
 
     # Previous committed artifact: the per-(model,batch) best-known record
     # drives degraded-tunnel detection, and skipped sections fall back to the
@@ -1365,6 +1434,7 @@ def main() -> None:
     # timeout mid-extras must not cost the recorded metric. If the first
     # model fails, the next successful one is promoted to headline rather
     # than aborting with no metric at all.
+    devlegs.begin("configs")
     head = None
     remaining = list(models)
     while remaining and head is None:
@@ -1452,9 +1522,11 @@ def main() -> None:
             r["degraded_vs_history"] = True
         results.append(r)
         stderr_line(r)
+    devlegs.end("configs")
 
     e2e = None
     if args.e2e and not over_budget("e2e"):
+        devlegs.begin("e2e")
         try:
             e2e = annotate_e2e(
                 bench_e2e(
@@ -1484,12 +1556,14 @@ def main() -> None:
                 )
         except Exception as e:
             print(f"[bench-e2e] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+        devlegs.end("e2e")
 
     # Flash-vs-dense attention microbench: the artifact behind the kernel's
     # perf claims (PARITY.md). Readback barriers, best-of-3 — over the
     # remote tunnel block_until_ready alone is not a barrier.
     flash = {}
     if not over_budget("flash"):
+        devlegs.begin("flash")
         try:
             flash = annotate_flash_entries(
                 bench_flash(deadline=time.monotonic() + CAPS["flash"]),
@@ -1506,6 +1580,7 @@ def main() -> None:
                 print(f"[bench-flash] {key}: {line}", file=sys.stderr)
         except Exception as e:
             print(f"[bench-flash] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+        devlegs.end("flash")
 
     # Batch curve: the data behind batch_overrides. Every point is
     # budget-gated individually, quick (no latency loop, best-of-2), and
@@ -1514,6 +1589,7 @@ def main() -> None:
     # budget. Points already measured as configs are reused, not re-run.
     curve: dict[str, list] = {}
     if args.curve and args.batch_size is None:
+        devlegs.begin("curve")
         # The points that justify batch_overrides (knee neighbors), nothing
         # more — every point is wall-clock the whole bench must absorb.
         points = [
@@ -1572,12 +1648,14 @@ def main() -> None:
                 f"{p['batch_size']}:{p['images_per_sec_per_chip']}" for p in pts
             )
             print(f"[bench-curve] {model} img/s/chip by batch: {line}", file=sys.stderr)
+        devlegs.end("curve")
 
     # Training throughput (beyond the reference entirely): last because the
     # serving numbers above are the BASELINE contract; budget-gated like
     # every extra.
     train = {}
     if not over_budget("train"):
+        devlegs.begin("train")
         try:
             train = annotate_train_entries(
                 bench_train(deadline=time.monotonic() + CAPS["train"]),
@@ -1594,11 +1672,13 @@ def main() -> None:
                 )
         except Exception as e:
             print(f"[bench-train] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+        devlegs.end("train")
 
     # Continuous-batching decode serving (dmlc_tpu/generate/): the LLM
     # serving twin of the train leg, budget-gated like every extra.
     lm_decode = {}
     if not over_budget("lm_decode"):
+        devlegs.begin("lm_decode")
         try:
             lm_decode = annotate_lm_decode_entries(
                 bench_lm_decode(deadline=time.monotonic() + CAPS["lm_decode"]),
@@ -1615,6 +1695,7 @@ def main() -> None:
                 )
         except Exception as e:
             print(f"[bench-lm-decode] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+        devlegs.end("lm_decode")
 
     # Extra models: measured numbers for the remaining reference configs,
     # strictly after every primary section has had its shot at the budget.
@@ -1655,6 +1736,7 @@ def main() -> None:
         "flash": flash,
         "train": train,
         "lm_decode": lm_decode,
+        "device": devlegs.section(results),
         "roofline_notes": ROOFLINE_NOTES,
     }
     if degraded:

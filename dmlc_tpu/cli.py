@@ -36,6 +36,18 @@ def format_table(headers: list[str], rows: list[list]) -> str:
     return "\n".join([line(headers), sep, *(line(r) for r in cells)])
 
 
+def _fmt_bytes(v) -> str:
+    """Human-scaled byte count for the device table ('-' for unknown)."""
+    if v is None:
+        return "-"
+    v = float(v)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024.0 or unit == "GiB":
+            return f"{v:.1f}{unit}" if unit != "B" else f"{v:.0f}B"
+        v /= 1024.0
+    return f"{v:.1f}GiB"
+
+
 def format_latency(summary: dict[str, float]) -> str:
     ms = lambda k: f"{summary[k] * 1e3:.2f}ms" if summary.get("count") else "-"
     return (
@@ -109,6 +121,10 @@ Commands (reference: README.md:10-23):
                                         lanes, --worst K slowest-p99 lanes)
   slo                                   per-model SLO burn rates + the current
                                         placement plan (leader's evaluator)
+  device                                device-plane fleet table (devicemon):
+                                        HBM used/limit, jit compiles +
+                                        compile-seconds, steady-state
+                                        recompiles, per-model MFU
   help                                  this text
   exit | quit                           leave and stop the node
 """
@@ -604,6 +620,48 @@ class Cli:
                 for name, ms in sorted(assignment.items()):
                     out.append(f"  {name}: {', '.join(ms)}")
             return "\n".join(out)
+        if cmd == "device":
+            # Device-plane fleet table (cluster/devicemon.py, docs/
+            # OBSERVABILITY.md §8), read from the leader's last obs scrape
+            # so it works from any member; falls back to this node's own
+            # gauges when no leader scrape is reachable.
+            try:
+                reply = n.rpc.call(n.tracker.current, "obs.fleet", {}, timeout=5.0)
+                fleet = reply.get("fleet") or {}
+            except Exception:
+                fleet = {}
+            if not fleet:
+                fleet = {n.self_member_addr: {"metrics": n.registry.snapshot()}}
+            rows = []
+            for addr, r in sorted(fleet.items()):
+                gauges = (r.get("metrics") or {}).get("gauges") or {}
+                used = gauges.get("hbm_bytes_in_use")
+                limit = gauges.get("hbm_limit_bytes")
+                hbm = (
+                    f"{_fmt_bytes(used)}/{_fmt_bytes(limit)}"
+                    if used is not None and limit is not None
+                    else "-"
+                )
+                mfu = ", ".join(
+                    f"{k[len('mfu_'):]}={v:.3f}"
+                    for k, v in sorted(gauges.items())
+                    if k.startswith("mfu_") and v is not None
+                )
+                compiles = gauges.get("jit_compiles")
+                seconds = gauges.get("jit_compile_seconds")
+                rows.append([
+                    addr,
+                    hbm,
+                    "-" if compiles is None else f"{compiles:g}",
+                    "-" if seconds is None else f"{seconds:.1f}s",
+                    f"{gauges.get('jit_steady_recompiles') or 0:g}",
+                    mfu or "-",
+                ])
+            return format_table(
+                ["node", "hbm used/limit", "compiles", "compile time",
+                 "steady recompiles", "mfu"],
+                rows,
+            )
         if cmd == "help":
             return HELP
         if cmd in ("exit", "quit"):

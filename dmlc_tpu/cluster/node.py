@@ -33,6 +33,7 @@ from dmlc_tpu.cluster import observe
 from dmlc_tpu.cluster.admission import AdmissionGate
 from dmlc_tpu.cluster.clock import Clock
 from dmlc_tpu.cluster.decodetier import DecodeTierClient
+from dmlc_tpu.cluster.devicemon import DeviceMonitor
 from dmlc_tpu.cluster.failover import LeaderTracker, StandbyLeader
 from dmlc_tpu.cluster.flight import FlightRecorder
 from dmlc_tpu.cluster.membership import MembershipNode
@@ -52,7 +53,7 @@ from dmlc_tpu.scheduler.worker import (
     ModelLoader,
     PredictWorker,
 )
-from dmlc_tpu.utils import tracing
+from dmlc_tpu.utils import compile_cache, tracing
 from dmlc_tpu.utils.config import ClusterConfig
 from dmlc_tpu.utils.metrics import Counters, Registry
 from dmlc_tpu.utils.tracing import traced_methods
@@ -67,6 +68,29 @@ def member_rpc_addr(gossip_addr: str, port_offset: int) -> str:
     fleet-wide, so several nodes can share a host in tests."""
     host, _, gport = gossip_addr.rpartition(":")
     return f"{host}:{int(gport) + port_offset}"
+
+
+def _backend_resident(backend) -> int | None:
+    """Resident device bytes of a predict backend's engine — None until the
+    lazy engine builds (or for backends without the capability, e.g. the
+    hermetic test fakes)."""
+    engine = getattr(backend, "_engine", None)
+    fn = getattr(engine, "resident_bytes", None)
+    try:
+        return int(fn()) if fn is not None else None
+    except Exception:  # noqa: BLE001 - gauge read must never raise
+        return None
+
+
+def _gen_resident(backend) -> int | None:
+    """Resident device bytes (weights + KV page pools) of a generation
+    backend's engine — None until the lazy scheduler/engine builds."""
+    sched = getattr(backend, "_scheduler", None)
+    fn = getattr(getattr(sched, "engine", None), "resident_bytes", None)
+    try:
+        return int(fn()) if fn is not None else None
+    except Exception:  # noqa: BLE001 - gauge read must never raise
+        return None
 
 
 class ClusterNode:
@@ -192,6 +216,23 @@ class ClusterNode:
         # export_fleet_trace below); 0 until a trace has been collected.
         self._trace_max_skew = 0.0
         self.registry.gauge("trace_max_skew_s", lambda: self._trace_max_skew)
+        # Device-plane telemetry (cluster/devicemon.py, OBSERVABILITY §8):
+        # compile census + HBM gauges + live MFU on the SAME registry the
+        # obs scrape exports, so the leader learns about recompiles and
+        # memory pressure the way it learns about queue depths. The
+        # persistent-compile-cache counters join the scrape too.
+        self.devicemon = DeviceMonitor(
+            self.registry,
+            flight=self.flight,
+            metrics=self.metrics,
+            profiler=self.profiler,
+            member=self.lane,
+            clock=self.clock.monotonic,
+            warmup_s=config.devicemon_warmup_s,
+            hbm_alert_fraction=config.devicemon_hbm_alert_fraction,
+            peak_flops=config.devicemon_peak_flops,
+        )
+        compile_cache.export_metrics(self.registry)
 
         # --- L1 membership over UDP gossip -----------------------------
         self.gossip = UdpTransport(config.host, config.gossip_port, auth=self.auth)
@@ -223,10 +264,20 @@ class ClusterNode:
                 }
             else:
                 backends = {
-                    name: EngineBackend(name, config.data_dir, batch_size=config.batch_size)
+                    name: EngineBackend(
+                        name, config.data_dir, batch_size=config.batch_size,
+                        device_work=self.devicemon.device_work,
+                    )
                     for name in config.job_models
                 }
         self.worker = PredictWorker(backends, gate=self.predict_gate)
+        # Per-model device accounting: resident_bytes_<model> (None until
+        # the lazy engine builds) + mfu_<model> gauges. Registered against
+        # the RAW backends, before any DynamicBatcher wrap below.
+        for name, backend in self.worker.backends.items():
+            self.devicemon.register_model(
+                name, resident_bytes=lambda b=backend: _backend_resident(b)
+            )
         # Idle decode capacity, scraped fleet-wide by the leader's obs loop
         # and folded into ingest-aware placement (scheduler/placement.py).
         self.registry.gauge("decode_lane_idle", self.worker.decode_lane_idle)
@@ -257,9 +308,14 @@ class ClusterNode:
                     profile=lambda sec, m=name: self.profiler.record(
                         m, self.lane, "gen/step", sec
                     ),
+                    device_work=self.devicemon.device_work,
                 )
                 for name in config.generate_models
             }
+            for name, gb in self._gen_backends.items():
+                self.devicemon.register_model(
+                    name, resident_bytes=lambda b=gb: _gen_resident(b)
+                )
             self.generate_worker = GenerateWorker(
                 self._gen_backends, session_ttl_s=config.gen_session_ttl_s
             )
@@ -299,6 +355,7 @@ class ClusterNode:
             self.flight.node = self.lane
             self.obs.lane = self.lane
             self.member_server.lane = self.lane
+            self.devicemon.member = self.lane
 
         # --- leader-candidate machinery --------------------------------
         candidates = config.leader_candidates or [f"{config.host}:{config.leader_port}"]
@@ -442,6 +499,11 @@ class ClusterNode:
                 # blobs, read from the obs scrape + SDFS directory.
                 decode_idle=self._member_decode_idle,
                 blob_locality=self._member_blob_locality,
+                # Memory-headroom HARD constraint (devicemon, ISSUE 15): a
+                # model is never assigned to a member whose scraped HBM
+                # headroom cannot hold its analytic resident bytes.
+                headroom=self._member_hbm_headroom,
+                model_bytes=self._model_required_bytes,
             )
         self.scheduler = JobScheduler(
             self.rpc,
@@ -604,6 +666,31 @@ class ClusterNode:
         v = (reply.get("metrics") or {}).get("gauges", {}).get("decode_lane_idle")
         return float(v) if v is not None else None
 
+    def _member_hbm_headroom(self, member: str) -> float | None:
+        """HBM headroom (limit - in_use bytes) from the leader's last obs
+        scrape of this member (the devicemon gauges every node registers).
+        None when unscraped or when the member's backend reports no memory
+        stats (CPU/sim) — unknown never blocks placement."""
+        reply = self.fleet_metrics.get(member)
+        if not reply:
+            return None
+        gauges = (reply.get("metrics") or {}).get("gauges", {})
+        limit, used = gauges.get("hbm_limit_bytes"), gauges.get("hbm_bytes_in_use")
+        if limit is None or used is None:
+            return None
+        return float(limit) - float(used)
+
+    def _model_required_bytes(self, model: str) -> float | None:
+        """Analytic weights residency for the headroom constraint. None for
+        models without a registry entry (hermetic test jobs) — no
+        constraint rather than a false refusal."""
+        try:
+            from dmlc_tpu.models.registry import get_model
+
+            return float(get_model(model).param_bytes())
+        except Exception:  # noqa: BLE001 - unknown models place unconstrained
+            return None
+
     def _member_blob_locality(self, member: str) -> float | None:
         """Fraction of the SDFS directory this member replicates — blobs it
         can decode without fetching first (docs/INGEST.md §Decode tier)."""
@@ -646,6 +733,8 @@ class ClusterNode:
                     log.exception("eager warmup failed; backend will build lazily")
         self._spawn(self._membership_loop)
         self._spawn(self._probe_loop)
+        if self.config.devicemon_poll_interval_s > 0:
+            self._spawn(self._devicemon_loop)
         if self.config.scrub_interval_s > 0:
             self._spawn(self._scrub_loop)
         if self.is_candidate:
@@ -706,6 +795,7 @@ class ClusterNode:
             gb.stop(timeout_s=2.0)
         for t in self._threads:
             t.join(timeout=2.0)
+        self.devicemon.close()  # unsubscribe from the process-global census
         self.member_server.close()
         if self.leader_server is not None:
             self.leader_server.close()
@@ -734,6 +824,12 @@ class ClusterNode:
 
     def _membership_loop(self):
         self._loop(self.config.heartbeat_interval_s, self.membership.step)
+
+    def _devicemon_loop(self):
+        """HBM watermark/alert poll (cluster/devicemon.py): tracks the
+        high-water mark and fires the ``hbm_high_watermark`` flight event
+        on the alert-fraction edge."""
+        self._loop(self.config.devicemon_poll_interval_s, self.devicemon.poll)
 
     def _probe_loop(self):
         def body():

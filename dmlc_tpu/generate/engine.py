@@ -88,11 +88,17 @@ class GenerationEngine:
         use_pallas: bool | None = None,
         return_logits: bool = False,
         seed: int = 0,
+        device_work: Any = None,
     ) -> None:
         import jax
         import jax.numpy as jnp
 
         from dmlc_tpu.models.registry import get_model
+
+        # Device-plane telemetry hook (cluster/devicemon.py): called with
+        # (model, tokens, seconds) per decode step so the node's
+        # DeviceMonitor can track achieved FLOP/s vs roofline. None = off.
+        self.device_work = device_work
 
         if cache not in ("paged", "contiguous"):
             raise ValueError(f"cache must be 'paged' or 'contiguous', got {cache!r}")
@@ -159,9 +165,16 @@ class GenerationEngine:
         self.last_logits: np.ndarray | None = None
         self._key = jax.random.PRNGKey(int(seed))
 
-        # The two compiled programs — built exactly once (J2/H1 contract).
-        self._step = self._build_step()
-        self._prefill = self._build_prefill()
+        # The two compiled programs — built exactly once (J2/H1 contract),
+        # census-wrapped so a steady-state recompile of either is a labeled
+        # flight alert (cluster/devicemon.py; the wrapper passes
+        # ``_cache_size`` through, so the ==1 invariant pins unchanged).
+        from dmlc_tpu.cluster.devicemon import CensusedJit
+
+        self._step = CensusedJit(f"gen/{self.model_name}/step", self._build_step())
+        self._prefill = CensusedJit(
+            f"gen/{self.model_name}/prefill", self._build_prefill()
+        )
 
     # ---- forward math ---------------------------------------------------
 
@@ -371,9 +384,12 @@ class GenerationEngine:
         Appends the previous sampled token to each slot's cache and samples
         the next; returns the sampled token per slot ([max_slots], only
         active rows meaningful). Host state advances for active slots."""
+        import time
+
         import jax
         import jax.numpy as jnp
 
+        t0 = time.perf_counter()
         self._key, sub = jax.random.split(self._key)
         table = (
             jnp.asarray(self.cache.page_table)
@@ -403,6 +419,10 @@ class GenerationEngine:
         self.last_tokens[self.active] = tokens[self.active]
         self.steps += 1
         self.tokens_out += n_active
+        if self.device_work is not None and n_active > 0:
+            # np.asarray(nxt) above materialized the step's results, so
+            # this wall is the step's real device+host latency.
+            self.device_work(self.model_name, n_active, time.perf_counter() - t0)
         return tokens
 
     def release(self, slot: int) -> list[int]:
@@ -432,6 +452,18 @@ class GenerationEngine:
     @property
     def pages_free(self) -> int:
         return self.cache.pages_free if self.cache_mode == "paged" else 0
+
+    def resident_bytes(self) -> int:
+        """Analytic device residency of this engine: weights pytree + both
+        KV pools (paged or contiguous) — the per-model attribution behind
+        the ``resident_bytes_<model>`` gauge (docs/OBSERVABILITY.md §8)."""
+        from dmlc_tpu.cluster.devicemon import pytree_nbytes
+
+        return (
+            pytree_nbytes(self._variables)
+            + pytree_nbytes(self._k_state)
+            + pytree_nbytes(self._v_state)
+        )
 
     def jit_cache_sizes(self) -> dict[str, int]:
         """Compiled-entry counts for the two programs — the recompile-free

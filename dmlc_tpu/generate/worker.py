@@ -61,6 +61,7 @@ class GenerationBackend:
         registry: Any = None,
         lane: Any = None,
         profile: Callable[[float], None] | None = None,
+        device_work: Any = None,
     ) -> None:
         self.model_name = model_name
         self.max_slots = int(max_slots)
@@ -74,6 +75,9 @@ class GenerationBackend:
         self.registry = registry
         self.lane = lane
         self.profile = profile
+        # Device-plane telemetry hook (cluster/devicemon.py): called with
+        # (model, tokens, device_seconds) per decode step.
+        self.device_work = device_work
         self._scheduler: SlotScheduler | None = None
         self._lock = threading.Lock()
 
@@ -98,6 +102,7 @@ class GenerationBackend:
                     num_pages=self.num_pages,
                     max_prefill=self.max_prefill,
                     use_pallas=self.use_pallas,
+                    device_work=self.device_work,
                 )
                 self._scheduler = SlotScheduler(
                     engine,

@@ -47,6 +47,14 @@ def triangle_weights(in_size: int, out_size: int) -> np.ndarray:
     return w
 
 
+# Shape combinations already seen by resize_batch: each NEW (N, H, W, out)
+# forces a fresh trace/compile of the einsums (standalone, or of the caller's
+# jit program when traced inline), so first-sight is exactly the
+# compile-census event (cluster/devicemon.py; the runtime face of rule A6's
+# "unstable shapes reaching jit" hazard).
+_SEEN_SHAPES: set = set()
+
+
 def resize_batch(images, out_size: int, dtype=jnp.float32):
     """[N, H, W, C] (any numeric dtype) -> [N, out, out, C] ``dtype``.
 
@@ -54,6 +62,12 @@ def resize_batch(images, out_size: int, dtype=jnp.float32):
     matmuls fused with whatever consumes the result. Static shapes only —
     one compile per (H, W, out) combination."""
     n, h, w, c = images.shape
+    combo = (int(n), int(h), int(w), int(out_size))
+    if combo not in _SEEN_SHAPES:
+        _SEEN_SHAPES.add(combo)
+        from dmlc_tpu.cluster.devicemon import CENSUS
+
+        CENSUS.record(f"device_resize/{h}x{w}->{out_size}")
     wy = jnp.asarray(triangle_weights(h, out_size), dtype)
     wx = jnp.asarray(triangle_weights(w, out_size), dtype)
     x = images.astype(dtype)

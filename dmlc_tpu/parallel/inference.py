@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from dmlc_tpu.cluster.devicemon import CensusedJit
 from dmlc_tpu.models import get_model
 from dmlc_tpu.ops import preprocess as pp
 from dmlc_tpu.parallel import mesh as mesh_lib
@@ -90,8 +91,13 @@ class InferenceEngine:
         seed: int = 0,
         use_pallas: bool | None = None,
         device_resize_from: int | None = None,
+        device_work=None,
     ):
         self.spec = get_model(model_name)
+        # Device-plane telemetry hook (cluster/devicemon.py): called with
+        # (model, items, seconds) per device execution so the node's
+        # DeviceMonitor can track achieved FLOP/s vs roofline. None = off.
+        self.device_work = device_work
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
         self.batch_size = int(batch_size)
         # Optional device-side resize (ops/device_resize.py): the host ships
@@ -190,7 +196,13 @@ class InferenceEngine:
                     "one contiguous run so local row order matches global row "
                     "order — build the mesh with an unpermuted device list"
                 )
-        self._forward = jax.jit(forward, in_shardings=(param_shd, data_shd), out_shardings=out_shd)
+        # Compile-census wrappers (cluster/devicemon.py): every jit site
+        # carries a stable program label so steady-state recompiles are
+        # attributable per program, not just per process.
+        self._forward = CensusedJit(
+            f"infer/{model_name}",
+            jax.jit(forward, in_shardings=(param_shd, data_shd), out_shardings=out_shd),
+        )
         # Stream-pipeline variant: donates the staged input buffer so XLA may
         # reuse its HBM while the pipeline stages the NEXT batch — the
         # double-buffered staging ring (run_paths_stream) owns each buffer
@@ -201,11 +213,14 @@ class InferenceEngine:
         if self.mesh.devices.flat[0].platform == "cpu":
             self._forward_stream = self._forward
         else:
-            self._forward_stream = jax.jit(
-                forward,
-                in_shardings=(param_shd, data_shd),
-                out_shardings=out_shd,
-                donate_argnums=(1,),
+            self._forward_stream = CensusedJit(
+                f"infer/{model_name}/stream",
+                jax.jit(
+                    forward,
+                    in_shardings=(param_shd, data_shd),
+                    out_shardings=out_shd,
+                    donate_argnums=(1,),
+                ),
             )
         # Per-stage ingest pipeline counters (INGEST_STAGES): decode/stage/
         # dispatch record from pool threads too, hence the lock.
@@ -262,6 +277,8 @@ class InferenceEngine:
         dt = time.perf_counter() - t0
         self._stats.record(dt)
         tracer.record("device/forward", dt, model=self.spec.name, batch=int(n))
+        if self.device_work is not None:
+            self.device_work(self.spec.name, int(n), dt)
         if self.spec.classifier:
             idx, top = (np.asarray(o) for o in out)
             return BatchResult(idx[:n], top[:n], None, dt)
@@ -304,6 +321,8 @@ class InferenceEngine:
         dt = time.perf_counter() - t0
         self._stats.record(dt)
         tracer.record("device/forward_global", dt, model=self.spec.name, batch=int(n))
+        if self.device_work is not None:
+            self.device_work(self.spec.name, int(n), dt)
 
         def local_rows(x) -> np.ndarray:
             # Dedupe on batch index: with a tp axis this process addresses
@@ -422,6 +441,12 @@ class InferenceEngine:
         total_dt = time.perf_counter() - t_all
         with self._ingest_lock:
             self._ingest["pipeline"].record(total_dt)
+        if self.device_work is not None:
+            # Pipeline wall, not isolated device time: on the stream path
+            # the honest achieved-FLOP/s figure includes ingest stalls (a
+            # decode-bound pipeline SHOULD read low MFU — that is the
+            # signal that the host, not the chip, is the bottleneck).
+            self.device_work(self.spec.name, len(paths), total_dt)
 
         if self.spec.classifier:
             idx = np.concatenate([np.asarray(o[0])[:n] for n, o in outs])
@@ -485,3 +510,11 @@ class InferenceEngine:
 
     def latency_summary(self) -> dict[str, float]:
         return self._stats.summary()
+
+    def resident_bytes(self) -> int:
+        """Analytic device residency: the sharded weights pytree (this
+        engine keeps no persistent activation state) — the per-model
+        attribution behind the ``resident_bytes_<model>`` gauge."""
+        from dmlc_tpu.cluster.devicemon import pytree_nbytes
+
+        return pytree_nbytes(self.variables)

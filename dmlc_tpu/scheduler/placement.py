@@ -253,6 +253,8 @@ class PlacementAdvisor:
         decode_idle: Callable[[str], float | None] | None = None,
         blob_locality: Callable[[str], float | None] | None = None,
         ingest_bias: float = 0.3,
+        headroom: Callable[[str], float | None] | None = None,
+        model_bytes: Callable[[str], float | None] | None = None,
     ) -> None:
         self.profiler = profiler
         self.flight = flight
@@ -272,6 +274,16 @@ class PlacementAdvisor:
         self.decode_idle = decode_idle
         self.blob_locality = blob_locality
         self.ingest_bias = float(ingest_bias)
+        # Memory-headroom HARD constraint (cluster/devicemon.py, docs/
+        # OBSERVABILITY.md §8): per-member HBM headroom bytes (scraped
+        # hbm_limit - hbm_in_use) and per-model analytic resident bytes.
+        # A (job, member) pair whose KNOWN headroom cannot hold the KNOWN
+        # model bytes is never assigned — unlike the ingest bias this is a
+        # refusal, not a weighting. None on either side = no constraint
+        # (unknown never blocks).
+        self.headroom = headroom
+        self.model_bytes = model_bytes
+        self._last_blocked: dict[str, list[str]] = {}
         self._last_ingest: dict[str, float] = {}
         self._last_plan: PlacementPlan | None = None
         self._excluded: set[str] = set()
@@ -329,6 +341,39 @@ class PlacementAdvisor:
                     f += self.ingest_bias * min(1.0, max(0.0, float(loc)))
             out[m] = round(f, 3)
         return out
+
+    def _blocked_pairs(
+        self, jobs: list[str], members: list[str]
+    ) -> dict[str, set[str]]:
+        """job -> members that MUST NOT serve it: the member's reported HBM
+        headroom (bytes) is known and smaller than the model's known
+        analytic resident bytes. Either side unknown = unconstrained."""
+        if self.headroom is None or self.model_bytes is None:
+            return {}
+        need: dict[str, float] = {}
+        for job in jobs:
+            try:
+                b = self.model_bytes(job)
+            except Exception:  # noqa: BLE001 - telemetry read; treat as unknown
+                b = None
+            if b is not None and b > 0:
+                need[job] = float(b)
+        if not need:
+            return {}
+        room: dict[str, float] = {}
+        for m in members:
+            try:
+                h = self.headroom(m)
+            except Exception:  # noqa: BLE001 - telemetry read; treat as unknown
+                h = None
+            if h is not None:
+                room[m] = float(h)
+        blocked: dict[str, set[str]] = {}
+        for job, nbytes in need.items():
+            bad = {m for m, h in room.items() if h < nbytes}
+            if bad:
+                blocked[job] = bad
+        return blocked
 
     def _exclusions(self, costs: dict[str, float], median: float) -> set[str]:
         """Sticky outlier set: enter above ``exclude_factor`` x median,
@@ -399,7 +444,16 @@ class PlacementAdvisor:
         if ingest:
             costs = {m: c / ingest.get(m, 1.0) for m, c in costs.items()}
 
-        plan = self._solve(jobs, eligible, costs, chip_weight)
+        # Hard headroom refusals, applied inside the solver: unlike the
+        # exclusion set above (cost outliers, fleet-wide) a block is per
+        # (job, member) — a member too full for vit_l14 may still serve
+        # resnet18.
+        blocked = self._blocked_pairs(sorted(jobs), sorted(members))
+        self._last_blocked = {j: sorted(ms) for j, ms in sorted(blocked.items())}
+        if blocked and self.metrics is not None:
+            self.metrics.inc("placement_headroom_blocked")
+
+        plan = self._solve(jobs, eligible, costs, chip_weight, blocked)
         plan.excluded = sorted(excluded)
         plan.trigger = trigger
 
@@ -463,16 +517,27 @@ class PlacementAdvisor:
                 note["ingest"] = ",".join(
                     f"{m}={f}" for m, f in sorted(ingest.items()) if f > 1.0
                 )
+            if blocked:
+                # Headroom refusals shaped this plan — a postmortem of a
+                # starved job must see WHICH members were refused (lint O2).
+                note["headroom_blocked"] = ";".join(
+                    f"{j}={','.join(sorted(ms))}" for j, ms in sorted(blocked.items())
+                )
             self.flight.note("placement_decision", **note)
         return plan
 
     def _solve(
         self, jobs: dict[str, int], eligible: list[str],
         costs: dict[str, float], chip_weight: dict[str, int],
+        blocked: dict[str, set[str]] | None = None,
     ) -> PlacementPlan:
         """Greedy balance: deal members (fastest first) to the job with the
-        highest remaining demand per unit of capacity already granted."""
+        highest remaining demand per unit of capacity already granted.
+        ``blocked`` pairs (headroom refusals) are never dealt — a job every
+        member is blocked for ends up with NO members, which is the
+        correct answer: dispatching it would OOM the member."""
         names = sorted(jobs)
+        blocked = blocked or {}
         capacity = {
             m: chip_weight.get(m, 1) / max(1e-9, costs.get(m, 1.0))
             for m in eligible
@@ -482,12 +547,19 @@ class PlacementAdvisor:
         for m in sorted(eligible, key=lambda m: (-capacity[m], m)):
             # Most-starved job first: demand per granted capacity, with
             # empty jobs infinitely starved so everyone gets one member.
+            candidates = [n for n in names if m not in blocked.get(n, ())]
+            if not candidates:
+                continue  # member too full for every job this pass
             target = max(
-                names,
+                candidates,
                 key=lambda n: (
                     float("inf") if not assignment[n]
                     else max(1, jobs[n]) / max(1e-9, granted[n]),
                     -len(assignment[n]),
+                    # Most-constrained first on ties: a job refused on more
+                    # members must take the members it CAN use, or an
+                    # unconstrained peer drains them and strands it.
+                    len(blocked.get(n, ())),
                     n,
                 ),
             )
@@ -544,6 +616,9 @@ class PlacementAdvisor:
             "window_s": self.window_s,
             "ingest_factors": {
                 m: f for m, f in sorted(self._last_ingest.items()) if f > 1.0
+            },
+            "headroom_blocked": {
+                j: list(ms) for j, ms in sorted(self._last_blocked.items())
             },
             "assignment": {} if plan is None else {
                 n: list(ms) for n, ms in sorted(plan.assignment.items())

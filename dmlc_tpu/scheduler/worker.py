@@ -377,10 +377,15 @@ class EngineBackend:
         variables=None,
         dtype=None,
         device_resize_from: int | None = None,
+        device_work=None,
     ):
         self.model_name = model_name
         self.data_dir = Path(data_dir)
         self.batch_size = batch_size
+        # Device-plane telemetry hook (cluster/devicemon.py): called with
+        # (model, items, device_seconds) per device execution; feeds the
+        # node's MFU window and compute cost lane.
+        self.device_work = device_work
         # Optional synsets -> local paths resolver (e.g. an SdfsImageSource
         # for the BASELINE "SDFS shard" config); None = local fixture dirs.
         self.image_source = image_source
@@ -435,6 +440,8 @@ class EngineBackend:
                 kw["dtype"] = self.dtype
             if self.device_resize_from is not None:
                 kw["device_resize_from"] = self.device_resize_from
+            if self.device_work is not None:
+                kw["device_work"] = self.device_work
             self._engine = InferenceEngine(
                 self.model_name, batch_size=self.batch_size, **kw
             )

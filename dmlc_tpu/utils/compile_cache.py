@@ -19,11 +19,73 @@ runs (the multichip dryrun, the hermetic test mesh) each land in
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
 import platform as _platform
+import threading
 from pathlib import Path
 
 _REPO_ROOT = Path(__file__).resolve().parents[2]
+
+log = logging.getLogger(__name__)
+
+# Persistent-cache observability (docs/OBSERVABILITY.md §8): jax announces
+# hits/misses through jax.monitoring events; ``enable()`` registers ONE
+# listener folding them here, and writes are inferred from cache-directory
+# growth since enable() (jax emits no write event). ``export_metrics``
+# exposes the lot as registry gauges so the silent cache becomes a scraped
+# fleet signal.
+_counts_lock = threading.Lock()
+_COUNTS = {"hits": 0, "misses": 0, "requests": 0}
+_LISTENER_INSTALLED = False
+_CACHE_ROOT: Path | None = None
+_BASELINE_ENTRIES = 0
+
+
+def _on_cache_event(event: str, **kw) -> None:
+    """jax.monitoring event listener (also driven directly by the unit
+    test): counts persistent-cache hit/miss/request events."""
+    if "/jax/compilation_cache/" not in event:
+        return
+    with _counts_lock:
+        if event.endswith("cache_hits"):
+            _COUNTS["hits"] += 1
+        elif event.endswith("cache_misses"):
+            _COUNTS["misses"] += 1
+        elif event.endswith("compile_requests_use_cache"):
+            _COUNTS["requests"] += 1
+
+
+def _count_entries(root: Path | None) -> int:
+    if root is None:
+        return 0
+    try:
+        return sum(1 for p in root.iterdir() if p.is_file())
+    except OSError:
+        return 0
+
+
+def counters() -> dict:
+    """Hit/miss/request counts since process start, plus writes (entries
+    added to the cache dir since ``enable()``) and the current entry
+    count. All zeros until ``enable()`` has installed the listener."""
+    with _counts_lock:
+        out = dict(_COUNTS)
+    entries = _count_entries(_CACHE_ROOT)
+    out["entries"] = entries
+    out["writes"] = max(0, entries - _BASELINE_ENTRIES)
+    return out
+
+
+def export_metrics(registry) -> None:
+    """Register the cache counters as gauges on a metrics Registry
+    (utils/metrics.py): ``jax_cache_hits`` / ``jax_cache_misses`` /
+    ``jax_cache_writes`` / ``jax_cache_entries``. Gauges read live, so one
+    registration at node build covers the process lifetime."""
+    registry.gauge("jax_cache_hits", lambda: counters()["hits"])
+    registry.gauge("jax_cache_misses", lambda: counters()["misses"])
+    registry.gauge("jax_cache_writes", lambda: counters()["writes"])
+    registry.gauge("jax_cache_entries", lambda: counters()["entries"])
 
 
 def _machine_fingerprint() -> str:
@@ -69,6 +131,7 @@ def _cpu_platform_selected() -> bool:
 
 
 def enable(cache_dir: str | None = None) -> None:
+    global _LISTENER_INSTALLED, _CACHE_ROOT, _BASELINE_ENTRIES
     import jax
 
     root = cache_dir or os.environ.get(
@@ -77,6 +140,17 @@ def enable(cache_dir: str | None = None) -> None:
     cpu = _cpu_platform_selected()
     if cpu:
         root = str(Path(root) / f"cpu-{_machine_fingerprint()}")
+    _CACHE_ROOT = Path(root)
+    _BASELINE_ENTRIES = _count_entries(_CACHE_ROOT)
+    if not _LISTENER_INSTALLED:
+        try:
+            from jax import monitoring as _monitoring
+
+            _monitoring.register_event_listener(_on_cache_event)
+            _LISTENER_INSTALLED = True
+        except Exception:  # noqa: BLE001 - older jax without monitoring: stay silent
+            log.debug("jax.monitoring unavailable; cache counters stay 0",
+                      exc_info=True)
     jax.config.update("jax_compilation_cache_dir", root)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
     # Persist XLA's internal (autotuning etc.) caches too, not just final

@@ -182,6 +182,21 @@ class ClusterConfig:
     # traces, not a 1% lottery.
     trace_burn_force_sample_s: float = 0.0
 
+    # --- device-plane telemetry (cluster/devicemon.py, OBSERVABILITY §8) ---
+    # HBM watermark/alert poll cadence (0 disables the poll loop; gauges
+    # still read live on every scrape).
+    devicemon_poll_interval_s: float = 5.0
+    # Compile-census warmup window: a program label compiling again this
+    # long after its FIRST compile is a steady-state recompile (flight
+    # event `recompile_steady_state` — runtime counterpart of rule A6).
+    devicemon_warmup_s: float = 60.0
+    # hbm_high_watermark flight event fires when bytes_in_use crosses this
+    # fraction of bytes_limit (re-arms below 0.9x the line).
+    devicemon_hbm_alert_fraction: float = 0.9
+    # Per-chip peak FLOP/s override for MFU (0 = the per-platform table in
+    # devicemon.PEAK_FLOPS: v5e bf16 for tpu, nominal 1 TF for cpu).
+    devicemon_peak_flops: float = 0.0
+
     # --- dynamic request micro-batching (scheduler/worker.DynamicBatcher) ---
     # Coalesce concurrent small `job.predict` requests into device-shaped
     # batches: a request waits at most this long for peers before its batch

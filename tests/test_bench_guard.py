@@ -305,6 +305,24 @@ class TestMergeDetail:
         assert out["e2e"] is None and out["flash"] == {}
         assert out["history_best"]["resnet18@1024"]["images_per_sec_per_chip"] == 30000.0
 
+    def test_device_section_replaced_wholesale_or_kept_stale(self):
+        # The device section is a whole-run delta ledger (ISSUE 15): a fresh
+        # capture replaces it outright; a run that produced none (crashed
+        # before section assembly, or a manual merge) keeps the previous
+        # capture stamped stale.
+        old = dict(self.OLD, device={"peak_flops": 197e12,
+                                     "legs": {"configs": {"compiles": 3}}})
+        fresh = {"configs": [_cfg()],
+                 "device": {"peak_flops": 1e12, "legs": {"configs": {"compiles": 1}}}}
+        out = bench.merge_detail(fresh, old)
+        assert out["device"]["peak_flops"] == 1e12
+        assert "stale" not in out["device"]
+        out2 = bench.merge_detail({"configs": [_cfg()]}, old)
+        assert out2["device"]["peak_flops"] == 197e12
+        assert out2["device"]["stale"] is True
+        # No capture on either side: no section invented.
+        assert "device" not in bench.merge_detail({"configs": [_cfg()]}, self.OLD)
+
 
 def test_load_prev_detail_preserves_corrupt_file(tmp_path, capsys):
     """A truncated/corrupt artifact is moved aside with a warning, never
@@ -334,11 +352,23 @@ def test_committed_artifact_has_all_sections_and_history():
     cite: every section present and non-empty, history_best populated."""
     detail = json.loads((bench.Path(__file__).parents[1] / "bench_detail.json").read_text())
     for key in ("configs", "e2e", "batch_curve", "flash", "train", "history_best",
-                "roofline_notes"):
+                "roofline_notes", "device"):
         assert detail.get(key), f"bench_detail.json[{key!r}] missing or empty"
     assert detail["history_best"].get("resnet18@1024", {}).get(
         "images_per_sec_per_chip", 0
     ) > 10000, "history_best lost the healthy headline record"
+    # Device section (ISSUE 15): roofline + census + per-leg ledger, with
+    # every MFU reading a ratio in (0, 1] against the platform peak — the
+    # shape ci_check.sh's bench-guard step keys on.
+    device = detail["device"]
+    assert device.get("peak_flops", 0) > 0
+    assert isinstance(device.get("legs"), dict) and device["legs"]
+    assert isinstance(device.get("census", {}).get("labels"), dict)
+    for config, mfu in device.get("mfu", {}).items():
+        assert 0 < mfu <= 1.0, f"device.mfu[{config!r}] = {mfu} not a ratio"
+    for name, leg in device["legs"].items():
+        assert leg.get("compiles", 0) >= 0, name
+        assert "peak_hbm_bytes" in leg, name  # present; None off-TPU
 
 
 def test_bench_py_compiles():
@@ -594,6 +624,43 @@ class TestLmDecodeGuard:
         out = bench.annotate_lm_decode_entries(
             {"continuous8": {"tokens_per_sec": 240.0}}, {})
         assert "degraded_vs_history" not in out["continuous8"]
+
+
+class TestDeviceLegs:
+    """bench.py's per-leg device-plane capture (ISSUE 15): census deltas
+    bracketed around each leg, assembled into bench_detail.json["device"]."""
+
+    def test_leg_captures_census_delta(self):
+        from dmlc_tpu.cluster.devicemon import CENSUS
+
+        dev = bench._DeviceLegs()
+        dev.begin("configs")
+        CENSUS.record("test/bench_guard_leg", seconds=0.25)
+        dev.end("configs")
+        leg = dev.legs["configs"]
+        assert leg["compiles"] == 1
+        assert leg["compile_seconds"] == 0.25
+        assert leg["steady_recompiles"] == 0
+        assert leg["wall_s"] >= 0
+        assert "peak_hbm_bytes" in leg and "hbm_limit_bytes" in leg
+
+    def test_end_without_begin_is_noop(self):
+        dev = bench._DeviceLegs()
+        dev.end("never_began")
+        assert dev.legs == {}
+
+    def test_section_shape_and_mfu_filter(self):
+        dev = bench._DeviceLegs()
+        dev.begin("configs")
+        dev.end("configs")
+        section = dev.section([
+            {"model": "resnet18", "batch_size": 1024, "mfu": 0.41},
+            {"model": "alexnet", "batch_size": 512, "mfu": None},
+        ])
+        assert section["mfu"] == {"resnet18@1024": 0.41}  # None rows dropped
+        assert section["peak_flops"] > 0
+        assert "configs" in section["legs"]
+        assert "labels" in section["census"]
 
 
 def test_bench_lm_decode_leg_smoke():

@@ -586,3 +586,80 @@ class TestPlacementSoak:
             e["kind"] == "placement_decision" and victim in e.get("excluded", "")
             for e in f.flight.events()
         )
+
+
+# ---------------------------------------------------------------------------
+# Memory-headroom HARD constraint (cluster/devicemon.py, ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+class TestHeadroomHardConstraint:
+    """A member whose scraped HBM headroom (hbm_limit - hbm_in_use) cannot
+    hold a model's analytic resident bytes is never dealt that model — a
+    refusal inside the solver, not a cost weighting. Unknown on either side
+    (unscraped member, CPU backend with no stats, unregistered model) never
+    blocks: absence of telemetry must not strand a job."""
+
+    def _advisor(self, headroom, model_bytes, **kw):
+        clock = VClock()
+        prof = make_profiler(clock)
+        adv = PlacementAdvisor(
+            prof, clock=clock, headroom=headroom, model_bytes=model_bytes, **kw
+        )
+        feed(prof, {"m0": 0.1, "m1": 0.1})
+        return adv
+
+    def test_refuses_member_whose_headroom_cannot_hold_the_model(self):
+        clock = VClock()
+        flight = FlightRecorder(clock=clock)
+        metrics = Counters()
+        room = {"m0": 8e9, "m1": 1e9}
+        adv = self._advisor(
+            room.get, lambda j: 2e9, flight=flight, metrics=metrics
+        )
+        plan = adv.advise({"job": 100}, ["m0", "m1"])
+        assert plan.assignment["job"] == ["m0"]
+        assert adv.status()["headroom_blocked"] == {"job": ["m1"]}
+        assert metrics.get("placement_headroom_blocked") == 1
+        # The refusal is reconstructible from the recorder (lint O2).
+        note = [e for e in flight.events() if e["kind"] == "placement_decision"][-1]
+        assert note["headroom_blocked"] == "job=m1"
+
+    def test_unknown_headroom_never_blocks(self):
+        adv = self._advisor(lambda m: None, lambda j: 2e9)
+        plan = adv.advise({"job": 100}, ["m0", "m1"])
+        assert sorted(plan.assignment["job"]) == ["m0", "m1"]
+        assert adv.status()["headroom_blocked"] == {}
+
+    def test_unknown_model_bytes_never_blocks(self):
+        adv = self._advisor(lambda m: 1e9, lambda j: None)
+        plan = adv.advise({"job": 100}, ["m0", "m1"])
+        assert sorted(plan.assignment["job"]) == ["m0", "m1"]
+        assert adv.status()["headroom_blocked"] == {}
+
+    def test_blocks_are_per_job_not_fleet_wide(self):
+        # m1 is too full for the big model but fine for the small one.
+        room = {"m0": 8e9, "m1": 1e9}
+        sizes = {"big": 4e9, "small": 1e8}
+        adv = self._advisor(room.get, sizes.get)
+        plan = adv.advise({"big": 50, "small": 50}, ["m0", "m1"])
+        assert plan.assignment["big"] == ["m0"]
+        assert "m1" in plan.assignment["small"]
+        assert adv.status()["headroom_blocked"] == {"big": ["m1"]}
+
+    def test_job_blocked_everywhere_gets_no_members(self):
+        # Dispatching it anywhere would OOM the member; an empty
+        # assignment is the correct, visible answer.
+        adv = self._advisor(lambda m: 1e9, {"big": 4e9, "small": 1e8}.get)
+        plan = adv.advise({"big": 50, "small": 50}, ["m0", "m1"])
+        assert plan.assignment["big"] == []
+        assert sorted(plan.assignment["small"]) == ["m0", "m1"]
+        assert adv.status()["headroom_blocked"] == {"big": ["m0", "m1"]}
+
+    def test_callback_errors_treated_as_unknown(self):
+        def boom(_):
+            raise RuntimeError("scrape race")
+
+        adv = self._advisor(boom, lambda j: 2e9)
+        plan = adv.advise({"job": 100}, ["m0", "m1"])
+        assert sorted(plan.assignment["job"]) == ["m0", "m1"]

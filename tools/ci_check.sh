@@ -26,13 +26,20 @@
 #                         merged Perfetto JSON must load and spans from
 #                         >= 2 nodes must share one trace_id with correct
 #                         parent ordering (tools/trace_smoke.py)
-#   8. loadgen smoke    — seeded flash-crowd replay through the sim fleet
+#   8. bench guard      — the committed bench_detail.json must keep every
+#                         section README/PARITY cite, including the
+#                         device-plane ledger (compile census, peak HBM,
+#                         MFU vs roofline) with every MFU a ratio in
+#                         (0, 1] — an MFU regression or a malformed
+#                         device capture fails here, machine-visibly
+#                         (tests/test_bench_guard.py)
+#   9. loadgen smoke    — seeded flash-crowd replay through the sim fleet
 #                         (tools/slo_cert.py): fails unless slo_cert.json
 #                         validates against the schema, error traces were
 #                         force-sampled into the merged fleet trace, and
 #                         leader scrape cost held the 4*sqrt(N) tree
 #                         bound; one leg per chaos seed base
-#   9. chaos matrix     — the seeded fault-injection suites (crashes,
+#  10. chaos matrix     — the seeded fault-injection suites (crashes,
 #                         partitions, failover, disk bit-rot/torn writes,
 #                         overload: deadlines/shedding/breakers/gray
 #                         ejection, the generation join/leave soak with
@@ -109,6 +116,15 @@ note "trace smoke (localcluster + merged fleet Perfetto trace)"
 if env JAX_PLATFORMS=cpu python tools/trace_smoke.py; then
   note "trace smoke OK"
 else
+  fail=1
+fi
+
+note "bench guard (bench_detail.json sections + device-plane ledger validation)"
+if env JAX_PLATFORMS=cpu python -m pytest tests/test_bench_guard.py -q \
+    -p no:cacheprovider; then
+  note "bench guard OK"
+else
+  note "bench guard FAILED (bench_detail.json lost a section or carries a malformed/regressed device capture)"
   fail=1
 fi
 

@@ -11,7 +11,11 @@ asserts the committed contract:
 - spans from >= 2 distinct node lanes (pids) share one trace_id,
 - no child span starts before its parent after alignment,
 - the generate request produced ``gen/step`` spans PARENTED into its
-  ``rpc/job.generate`` trace (docs/GENERATE.md's tracing contract).
+  ``rpc/job.generate`` trace (docs/GENERATE.md's tracing contract),
+- the leader's fleet scrape surfaces the device-plane gauges
+  (docs/OBSERVABILITY.md §8): compile census with real compiles counted,
+  per-model ``mfu_*`` gauges, and the ``hbm_*`` keys (None-valued on CPU,
+  but PRESENT — graceful degradation, not absence).
 
 Exit 0 on success; nonzero with a diagnostic otherwise.
 """
@@ -87,6 +91,32 @@ def main() -> int:
             for lanes in profile.get("profiles", {}).values()
             for member in lanes
         }
+
+        # Device-plane telemetry (docs/OBSERVABILITY.md §8): the completed
+        # predict compiled real programs, so the next fleet scrape must
+        # carry the devicemon gauges for every member — compile census with
+        # compiles counted, an mfu_* gauge per registered model, and the
+        # hbm_* keys (None on CPU backends, but present).
+        def _device_members() -> list[str]:
+            good = []
+            for addr, reply in leader.fleet_metrics.items():
+                gauges = (reply.get("metrics") or {}).get("gauges", {})
+                if (
+                    "hbm_bytes_in_use" in gauges
+                    and "hbm_limit_bytes" in gauges
+                    and any(k.startswith("mfu_") for k in gauges)
+                    and (gauges.get("jit_compiles") or 0) > 0
+                ):
+                    good.append(addr)
+            return good
+
+        n_members = len(leader.active_member_addrs())
+        wait_until(
+            lambda: len(_device_members()) >= n_members,
+            timeout=30.0,
+            msg="devicemon gauges in the fleet scrape for every member",
+        )
+        device_members = _device_members()
     finally:
         tracing.disable()
         stop_local_cluster(nodes)
@@ -151,7 +181,8 @@ def main() -> int:
         f"trace smoke OK: {len(events)} spans, {len(by_trace)} traces, "
         f"{len(multi_node)} crossing >= 2 nodes, "
         f"{len(gen_steps)} parented gen/step span(s), "
-        f"profile lanes for {len(profile_members)} members"
+        f"profile lanes for {len(profile_members)} members, "
+        f"device-plane gauges for {len(device_members)} members"
     )
     return 0
 
