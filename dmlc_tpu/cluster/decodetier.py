@@ -37,13 +37,18 @@ import logging
 import threading
 import time
 from pathlib import Path
-from typing import Callable, Sequence
+from typing import TYPE_CHECKING, Callable, Sequence
 
 import numpy as np
 
-from dmlc_tpu.cluster.rpc import DecodeError, RpcError
+from dmlc_tpu.cluster.rpc import DecodeError, Rpc, RpcError
 from dmlc_tpu.utils.hotpath import hot_path
 from dmlc_tpu.utils.tracing import tracer
+
+if TYPE_CHECKING:
+    from dmlc_tpu.cluster.flight import FlightRecorder
+    from dmlc_tpu.cluster.retrypolicy import RetryPolicy
+    from dmlc_tpu.utils.metrics import Metrics
 
 log = logging.getLogger(__name__)
 
@@ -60,18 +65,18 @@ class DecodeTierClient:
 
     def __init__(
         self,
-        rpc,
+        rpc: Rpc,
         members: Callable[[], Sequence[str]],
         *,
         min_batch: int = 16,
         max_bytes_per_rpc: int = 4 * 1024 * 1024,
         timeout_s: float = 30.0,
         fanout: int = 8,
-        retry_policy=None,
-        metrics=None,
-        flight=None,
+        retry_policy: RetryPolicy | None = None,
+        metrics: Metrics | None = None,
+        flight: FlightRecorder | None = None,
         clock: Callable[[], float] | None = None,
-    ):
+    ) -> None:
         self.rpc = rpc
         # Injectable timebase (lint D1): the sim harness passes its virtual
         # clock; production reads the process monotonic clock.
@@ -97,7 +102,7 @@ class DecodeTierClient:
 
     # ---- stats ----------------------------------------------------------
 
-    def stats(self) -> dict:
+    def stats(self) -> dict[str, int | float | None]:
         """Per-stage decode-tier stats (bench_detail.json's ``decode_tier``
         section): local vs remote decoded counts and the measured fleet
         decode rate over everything this client has pushed through."""

@@ -38,7 +38,11 @@ import logging
 import threading
 import time
 from collections import deque
-from typing import Any, Callable
+from typing import TYPE_CHECKING, Any, Callable
+
+if TYPE_CHECKING:
+    from dmlc_tpu.cluster.flight import FlightRecorder
+    from dmlc_tpu.utils.metrics import Metrics, Registry
 
 log = logging.getLogger(__name__)
 
@@ -255,10 +259,10 @@ class DeviceMonitor:
 
     def __init__(
         self,
-        registry: Any,
+        registry: Registry | None,
         *,
-        flight: Any = None,
-        metrics: Any = None,
+        flight: FlightRecorder | None = None,
+        metrics: Metrics | None = None,
         profiler: Any = None,
         member: str = "",
         clock: Callable[[], float] = time.monotonic,

@@ -26,7 +26,7 @@ import errno
 import random
 from collections import deque
 from pathlib import Path
-from typing import BinaryIO
+from typing import BinaryIO, Callable
 
 from dmlc_tpu.cluster.diskio import DiskIo
 
@@ -101,6 +101,58 @@ class FaultyIo(DiskIo):
             self.injected.append("torn_rename")
             raise OSError(errno.EIO, "crash before rename (injected)")
         super().rename(src, dst)
+
+
+class SimCrash(Exception):
+    """A simulated process death at a durability seam (dmlc-mc's crash
+    injection, docs/MODELCHECK.md). Raised from a ``CrashPointIo`` primitive;
+    it unwinds through the store code exactly like a dying process would
+    leave the disk — whatever was durably committed before the seam stays,
+    everything after never happens — and surfaces to a remote caller as the
+    generic RpcError a dead TCP peer becomes (SimRpcNetwork._call_from)."""
+
+
+class CrashPointIo(DiskIo):
+    """DiskIo whose primitives consult a hook before executing.
+
+    The hook is called with the primitive's name (``"open_write"``,
+    ``"write"``, ``"fsync"``, ``"rename"``, ``"fsync_dir"``); returning True
+    raises ``SimCrash`` at that exact seam. ``ops`` records every primitive
+    reached, so a model checker can first count a scenario's seams and then
+    enumerate crash-at-op-k schedules deterministically. Read primitives are
+    never crash points: a crash between reads is indistinguishable from one
+    between events, so only the durability seams multiply schedules."""
+
+    def __init__(self, hook: Callable[[str], bool] | None = None):
+        self.hook = hook
+        self.ops: list[str] = []
+        self.crashed = False
+
+    def _seam(self, op: str) -> None:
+        self.ops.append(op)
+        if self.hook is not None and self.hook(op):
+            self.crashed = True
+            raise SimCrash(f"process died at {op} (op #{len(self.ops)})")
+
+    def open_write(self, path: str | Path) -> BinaryIO:
+        self._seam("open_write")
+        return super().open_write(path)
+
+    def write(self, f: BinaryIO, data: bytes) -> None:
+        self._seam("write")
+        super().write(f, data)
+
+    def fsync(self, f: BinaryIO) -> None:
+        self._seam("fsync")
+        super().fsync(f)
+
+    def rename(self, src: str | Path, dst: str | Path) -> None:
+        self._seam("rename")
+        super().rename(src, dst)
+
+    def fsync_dir(self, path: str | Path) -> None:
+        self._seam("fsync_dir")
+        super().fsync_dir(path)
 
 
 # ---------------------------------------------------------------------------

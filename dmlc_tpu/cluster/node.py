@@ -31,7 +31,7 @@ from pathlib import Path
 
 from dmlc_tpu.cluster import observe
 from dmlc_tpu.cluster.admission import AdmissionGate
-from dmlc_tpu.cluster.clock import Clock
+from dmlc_tpu.cluster.clock import Clock, TimerRegistry
 from dmlc_tpu.cluster.decodetier import DecodeTierClient
 from dmlc_tpu.cluster.devicemon import DeviceMonitor
 from dmlc_tpu.cluster.failover import LeaderTracker, StandbyLeader
@@ -129,6 +129,10 @@ class ClusterNode:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._announced = False  # restart inventory re-announce (probe loop)
+        # Every maintenance loop's body registers here (see _timer): one
+        # named dispatch table shared by the deployment threads and the
+        # dmlc-mc schedule explorer (docs/MODELCHECK.md).
+        self.timers = TimerRegistry()
 
         # --- observability plane (docs/OBSERVABILITY.md) ----------------
         # ONE counter registry, ONE flight recorder, and ONE retry governor
@@ -805,6 +809,15 @@ class ClusterNode:
         self.flight.note("node_stop")
         self.flight.dump(self.flight_dump_path(), reason="stop")
 
+    def _timer(self, name: str, interval: float, body) -> None:
+        """Register ``body`` as the named timer and tick it on the wall
+        clock. All cadenced maintenance goes through this one seam so the
+        timer table (``self.timers``) is the complete, firable inventory of
+        this node's periodic work — deployment threads and the dmlc-mc
+        explorer dispatch the identical bodies."""
+        self.timers.register(name, interval, body)
+        self._loop(interval, lambda: self.timers.fire(name))
+
     def _loop(self, interval: float, body) -> None:
         while not self._stop.is_set():
             try:
@@ -823,13 +836,15 @@ class ClusterNode:
             self._stop.wait(interval)
 
     def _membership_loop(self):
-        self._loop(self.config.heartbeat_interval_s, self.membership.step)
+        self._timer("membership", self.config.heartbeat_interval_s,
+                    self.membership.step)
 
     def _devicemon_loop(self):
         """HBM watermark/alert poll (cluster/devicemon.py): tracks the
         high-water mark and fires the ``hbm_high_watermark`` flight event
         on the alert-fraction edge."""
-        self._loop(self.config.devicemon_poll_interval_s, self.devicemon.poll)
+        self._timer("devicemon", self.config.devicemon_poll_interval_s,
+                    self.devicemon.poll)
 
     def _probe_loop(self):
         def body():
@@ -838,7 +853,7 @@ class ClusterNode:
             if not self._announced:
                 self._try_announce()
 
-        self._loop(self.config.leader_probe_interval_s, body)
+        self._timer("probe", self.config.leader_probe_interval_s, body)
 
     def _try_announce(self) -> None:
         """Push this store's recovered inventory to the acting leader
@@ -887,7 +902,7 @@ class ClusterNode:
                 self.flight.note("scrub_corrupt", name=name, version=int(version))
                 self.sdfs.report_corrupt(name, version, self.self_member_addr)
 
-        self._loop(self.config.scrub_interval_s, body)
+        self._timer("scrub", self.config.scrub_interval_s, body)
 
     def scrub(self) -> dict:
         """CLI verb: one FULL verification pass over this node's store
@@ -899,14 +914,14 @@ class ClusterNode:
         return {"scanned": scanned, "corrupt": corrupt}
 
     def _heal_loop(self):
-        self._loop(
-            self.config.rereplication_interval_s,
+        self._timer(
+            "heal", self.config.rereplication_interval_s,
             lambda: self._if_leading(lambda: self.sdfs_leader.heal_once()),
         )
 
     def _assign_loop(self):
-        self._loop(
-            self.config.assignment_interval_s,
+        self._timer(
+            "assign", self.config.assignment_interval_s,
             lambda: self._if_leading(self.scheduler.assign_once),
         )
 
@@ -924,14 +939,18 @@ class ClusterNode:
             # off so retries don't become a zero-sleep RPC flood.
             self._stop.wait(0.05)
 
+        # W workers share one registration (the body is stateless between
+        # ticks); the registry needs the NAME firable, not the thread count.
+        self.timers.register("dispatch", 0.05, body)
         while not self._stop.is_set():
             try:
-                body()
+                self.timers.fire("dispatch")
             except Exception:
                 log.exception("dispatch loop error")
 
     def _standby_loop(self):
-        self._loop(self.config.leader_probe_interval_s, self.standby.step)
+        self._timer("standby", self.config.leader_probe_interval_s,
+                    self.standby.step)
 
     def _obs_scrape_loop(self):
         """Leader-side fleet metrics scrape (docs/OBSERVABILITY.md): while
@@ -987,8 +1006,8 @@ class ClusterNode:
             if self.config.profile_persist:
                 self.profiler.save(self.profile_path())
 
-        self._loop(
-            self.config.leader_probe_interval_s,
+        self._timer(
+            "obs_scrape", self.config.leader_probe_interval_s,
             lambda: self._if_leading(body),
         )
 

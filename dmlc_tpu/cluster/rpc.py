@@ -48,6 +48,44 @@ log = logging.getLogger(__name__)
 
 Method = Callable[[dict], dict]
 
+#: Verbs that are SAFE TO DELIVER MORE THAN ONCE per logical request — the
+#: at-least-once contract of every retrying caller in the tree. A verb
+#: belongs here iff a duplicate execution (lost reply -> caller re-sends;
+#: network-level replay) cannot corrupt state or double-count an effect:
+#: pure reads, pure compute, set-semantics merges, and the cumulative-ack
+#: poll protocol. dmlc-analyze rule A9 (tools/analyze/rules/retrysafety.py)
+#: flags any verb dispatched on a RetryPolicy-governed retry path that is
+#: missing from this table, and dmlc-mc (tools/mc) reads it to decide where
+#: duplicate-delivery injection is a legal schedule choice. Values are the
+#: one-line justification a reviewer should be able to refute.
+IDEMPOTENT_VERBS: dict[str, str] = {
+    # pure compute: output is a function of the request payload only
+    "job.predict": "stateless forward pass; duplicates waste work, not state",
+    "job.predict_gang": "stateless gang forward pass",
+    "job.decode_gang": "stateless gang decode pass",
+    "job.decode": "pure JPEG decode of shipped bytes",
+    # pure reads
+    "sdfs.get": "directory lookup of (name, version) -> replicas + digest",
+    "sdfs.fetch": "read of an immutable (name, version) blob",
+    "sdfs.fetch_meta": "read of an immutable (name, version) sidecar",
+    "sdfs.fetch_chunk": "read of an immutable (name, version) byte range",
+    "leader.status": "leadership/epoch read",
+    "obs.metrics": "metrics snapshot read",
+    # set-semantics merges: re-applying the same fact is a no-op
+    "sdfs.announce": "inventory merge; re-announcing the same set converges",
+    "sdfs.report_corrupt": "corruption verdict is a set insert",
+    # the exactly-once substrate itself: chunks are retained until the
+    # CUMULATIVE ack covers them, so a replayed poll re-reads identical
+    # chunks and the client dedups by seq (generate/slots.GenStream)
+    "job.generate_poll": "cumulative-ack chunk retention dedups replays",
+}
+
+#: dmlc-mc schedule-choice actions a SimRpcNetwork hook may return.
+MC_DELIVER = "deliver"            # normal dispatch
+MC_DROP_REQUEST = "drop_request"  # lost before the method ran
+MC_DROP_REPLY = "drop_reply"      # method ran; the caller never hears
+MC_DUPLICATE = "duplicate"        # delivered twice (at-least-once replay)
+
 
 class RpcError(Exception):
     """Transport failure or remote method failure."""
@@ -196,6 +234,11 @@ class SimRpcNetwork(Rpc):
         self.frames: list[dict] = []
         self.now = 0.0                          # virtual clock (seconds)
         self.latency: dict[tuple[str, str], float] = {}  # (src, dst) -> s
+        # dmlc-mc schedule hook (docs/MODELCHECK.md): called per reachable
+        # call with (source, addr, method); returns one of the MC_* actions.
+        # The fabric stays byte-identical with the hook unset — the None
+        # check is the entire production cost of the seam.
+        self.mc_hook: Callable[[str, str, str], str] | None = None
 
     def serve(self, addr: str, methods: dict[str, Method]) -> None:
         self.services[addr] = methods
@@ -271,18 +314,46 @@ class SimRpcNetwork(Rpc):
         if t is not None:
             frame["t"] = t
         self.frames.append(frame)
-        try:
-            return serve_with_deadline(
-                self.services[addr], method, payload, budget - lat,
-                clock=self.clock, trace=frame.get("t"), lane=addr,
+        action = MC_DELIVER
+        if self.mc_hook is not None:
+            action = self.mc_hook(source, addr, method)
+        if action == MC_DROP_REQUEST:
+            # The frame never arrived: the caller waits out its budget and
+            # the method never runs (a lost datagram / dead TCP dial).
+            self.now += budget - lat
+            raise RpcUnreachable(
+                f"{addr}/{method}: request lost in transit (mc schedule)"
             )
-        except RpcError:
-            raise
-        except Exception as e:
-            # Fidelity with the TCP fabric: a crashed method arrives at the
-            # caller as a remote RpcError (TcpRpcServer._serve_conn), never
-            # as the raw exception on the caller's stack.
-            raise RpcError(f"{type(e).__name__}: {e}") from e
+
+        def dispatch() -> dict:
+            try:
+                return serve_with_deadline(
+                    self.services[addr], method, payload, budget - lat,
+                    clock=self.clock, trace=frame.get("t"), lane=addr,
+                )
+            except RpcError:
+                raise
+            except Exception as e:
+                # Fidelity with the TCP fabric: a crashed method arrives at
+                # the caller as a remote RpcError (TcpRpcServer._serve_conn),
+                # never as the raw exception on the caller's stack.
+                raise RpcError(f"{type(e).__name__}: {e}") from e
+
+        reply = dispatch()
+        if action == MC_DUPLICATE:
+            # At-least-once replay: the server executes the SAME frame again
+            # (retried send after a timeout the caller never saw). Only legal
+            # where the scenario consulted IDEMPOTENT_VERBS — the explorer
+            # asserts that, not the fabric.
+            reply = dispatch()
+        if action == MC_DROP_REPLY:
+            # The method ran — its effects stand — but the reply is lost, so
+            # the caller sees the same verdict a reply-less timeout yields.
+            self.now += budget - lat
+            raise RpcUnreachable(
+                f"{addr}/{method}: reply lost in transit (mc schedule)"
+            )
+        return reply
 
 
 class SimRpcClient(Rpc):

@@ -33,16 +33,21 @@ from __future__ import annotations
 import logging
 import math
 from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Callable, Iterable
 
 from dmlc_tpu.cluster import observe
 from dmlc_tpu.cluster.rpc import Rpc, RpcError, RpcUnreachable
 from dmlc_tpu.utils import metrics as metrics_mod
 from dmlc_tpu.utils.tracing import traced_methods
 
+if TYPE_CHECKING:
+    from dmlc_tpu.cluster.flight import FlightRecorder
+    from dmlc_tpu.utils.metrics import Metrics
+
 log = logging.getLogger(__name__)
 
 
-def partition_spans(addrs, span_size: int = 0) -> list[list[str]]:
+def partition_spans(addrs: Iterable[str], span_size: int = 0) -> list[list[str]]:
     """Cut the sorted member ring into contiguous spans. ``span_size`` 0
     picks ceil(sqrt(N)) — balancing delegate count against per-delegate
     fan-out. Every address lands in exactly one span."""
@@ -71,7 +76,7 @@ class ScrapeDelegate:
     MAX_SPAN = 256
 
     def __init__(self, rpc: Rpc, *, timeout_s: float = 2.0,
-                 concurrency: int = 1, metrics=None):
+                 concurrency: int = 1, metrics: Metrics | None = None) -> None:
         self.rpc = rpc
         self.timeout_s = timeout_s
         self.concurrency = concurrency
@@ -159,9 +164,11 @@ class ScrapeTreeCoordinator:
     # the leader at 2D <= 4*sqrt(N) RPCs even on a bad cycle.
     ATTEMPTS = 2
 
-    def __init__(self, rpc: Rpc, *, clock, span_size: int = 0,
+    def __init__(self, rpc: Rpc, *, clock: Callable[[], float],
+                 span_size: int = 0,
                  timeout_s: float = 2.0, concurrency: int = 1,
-                 metrics=None, flight=None):
+                 metrics: Metrics | None = None,
+                 flight: FlightRecorder | None = None) -> None:
         self.rpc = rpc
         self.clock = clock
         self.span_size = span_size
@@ -171,7 +178,7 @@ class ScrapeTreeCoordinator:
         self.flight = flight
         self._last_fresh: dict[str, float] = {}
 
-    def scrape(self, addrs) -> ScrapeTreeResult:
+    def scrape(self, addrs: Iterable[str]) -> ScrapeTreeResult:
         spans = partition_spans(addrs, self.span_size)
         result = ScrapeTreeResult()
         merged_parts: list[dict] = []
@@ -217,7 +224,9 @@ class ScrapeTreeCoordinator:
             self.metrics.observe_high("scrape_tree_rpcs", result.leader_rpcs)
         return result
 
-    def _scrape_one_span(self, span):
+    def _scrape_one_span(
+        self, span: list[str]
+    ) -> tuple[dict | None, str, int, str]:
         """Try the span's delegate candidates in ring order; first success
         wins. Returns (partial | None, delegate, attempts, last_reason)."""
         reason = "no delegate candidates"

@@ -1,0 +1,266 @@
+"""dmlc-mc explorer mechanics + scenario smoke (docs/MODELCHECK.md).
+
+Exhaustive exploration of the real scenarios is ci_check.sh's bounded mc
+step; tier-1 keeps to what runs in milliseconds — explorer correctness on
+toy worlds with known tree shapes, DPOR-vs-full equivalence, shrinking to a
+known minimum, the lock monitor, strict-replay determinism, and ONE
+directed schedule through each real scenario.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import pytest
+
+from tools.mc import scenarios
+from tools.mc.core import (
+    Event,
+    InvariantViolation,
+    explore,
+    random_walks,
+    run_one,
+)
+from tools.mc.locks import LockMonitor
+from tools.mc.repro import load, replay, save, to_doc
+from tools.mc.shrink import shrink
+
+
+# ---------------------------------------------------------------------------
+# toy worlds with known tree shapes
+# ---------------------------------------------------------------------------
+
+
+class _ABWorld:
+    """Two independent two-event chains (a1 a2 | b1 b2): 4!/(2!2!) = 6
+    interleavings, of which DPOR needs only 1 (everything commutes)."""
+
+    def __init__(self, fail_on: str | None = None):
+        self.left = {"a": 2, "b": 2}
+        self.fired: list[str] = []
+        self.fail_on = fail_on
+
+    def enabled(self) -> list[Event]:
+        out = []
+        for side in ("a", "b"):
+            if self.left[side] > 0:
+                n = 3 - self.left[side]
+                out.append(Event(
+                    f"{side}{n}", (lambda s=side: self._fire(s)),
+                    frozenset({side}),
+                ))
+        return out
+
+    def _fire(self, side: str) -> None:
+        n = 3 - self.left[side]
+        self.left[side] -= 1
+        self.fired.append(f"{side}{n}")
+        if self.fail_on is not None and self.fired[-1] == self.fail_on:
+            raise InvariantViolation("toy-fail", f"fired {self.fail_on}")
+
+    def invariants(self) -> list[tuple[str, Callable[[], None]]]:
+        return []
+
+    def close(self) -> None:
+        pass
+
+
+class _ABScenario:
+    name = "toy_ab"
+
+    def __init__(self, fail_on: str | None = None):
+        self.fail_on = fail_on
+
+    def build(self) -> _ABWorld:
+        return _ABWorld(self.fail_on)
+
+
+class _DepWorld(_ABWorld):
+    """Same chains but every event shares one footprint: nothing commutes,
+    DPOR must not prune anything."""
+
+    def enabled(self) -> list[Event]:
+        return [
+            Event(e.label, e.fire, frozenset({"shared"}))
+            for e in super().enabled()
+        ]
+
+
+class _DepScenario:
+    name = "toy_dep"
+
+    def build(self) -> _DepWorld:
+        return _DepWorld()
+
+
+def test_exhaustive_visits_every_interleaving():
+    result = explore(_ABScenario(), dpor=False)
+    assert result.exhausted
+    assert result.schedules == 6  # 4!/(2!2!)
+    assert result.pruned == 0
+    assert result.findings == []
+
+
+def test_dpor_prunes_commuting_branches_without_losing_bugs():
+    full = explore(_ABScenario(fail_on="b2"), dpor=False)
+    pruned = explore(_ABScenario(fail_on="b2"), dpor=True)
+    assert pruned.schedules < full.schedules
+    assert pruned.pruned > 0
+    # same verdicts: the bug lives in every schedule reaching b2, and both
+    # modes report it (dedup by invariant+message collapses it to one)
+    assert [f.invariant for f in full.findings] == ["toy-fail"]
+    assert [f.invariant for f in pruned.findings] == ["toy-fail"]
+
+
+def test_dpor_keeps_dependent_branches():
+    assert explore(_DepScenario(), dpor=True).schedules == 6
+
+
+def test_strict_replay_is_deterministic():
+    prefix = ["b1", "a1", "b2", "a2"]
+    r1 = run_one(_ABScenario(), prefix)
+    r2 = run_one(_ABScenario(), prefix)
+    assert r1.labels == r2.labels == prefix
+    assert r1.violation is None
+
+
+def test_strict_replay_rejects_divergent_prefix():
+    from tools.mc.core import ScheduleDivergence
+
+    with pytest.raises(ScheduleDivergence):
+        run_one(_ABScenario(), ["a1", "a1"])
+
+
+def test_loose_replay_skips_unenabled_labels():
+    run = run_one(_ABScenario(), ["zz", "b1", "zz2", "b2"], strict=False)
+    assert run.labels[:2] == ["b1", "b2"]
+    assert run.violation is None
+
+
+def test_random_walks_are_seed_stable():
+    w1 = random_walks(_ABScenario(fail_on="b2"), walks=5, seed=42)
+    w2 = random_walks(_ABScenario(fail_on="b2"), walks=5, seed=42)
+    assert w1.schedules == w2.schedules == 5
+    assert [f.trace for f in w1.findings] == [f.trace for f in w2.findings]
+
+
+def test_shrink_reaches_minimal_schedule():
+    witness = ["a1", "b1", "a2", "b2"]  # b2 is the bug; a* are incidental
+    run = run_one(_ABScenario(fail_on="b2"), witness)
+    assert run.violation is not None
+    shrunk = shrink(_ABScenario(fail_on="b2"), witness, "toy-fail")
+    assert shrunk == ["b1", "b2"]  # 1-minimal: b2 needs b1 to be enabled
+
+
+def test_repro_round_trip(tmp_path):
+    result = explore(_ABScenario(fail_on="b2"))
+    doc = to_doc(result.findings[0])
+    path = save(doc, tmp_path / "toy.json")
+    loaded = load(path)
+    assert loaded["invariant"] == "toy-fail"
+    # replay goes through the registry, so round-trip on a real scenario:
+    run = run_one(_ABScenario(fail_on="b2"), loaded["trace"], strict=False)
+    assert run.violation is not None
+
+
+# ---------------------------------------------------------------------------
+# lock monitor
+# ---------------------------------------------------------------------------
+
+
+class _Locked:
+    def __init__(self):
+        import threading
+
+        self.lock_a = threading.Lock()
+        self.lock_b = threading.Lock()
+
+
+def test_lock_monitor_accepts_ordered_and_rejects_inverted():
+    obj = _Locked()
+    mon = LockMonitor(levels={"A": 1, "B": 2})
+    mon.instrument(obj, "lock_a", "A")
+    mon.instrument(obj, "lock_b", "B")
+    with obj.lock_a:
+        with obj.lock_b:
+            pass  # A -> B follows the levels
+    obj2 = _Locked()
+    mon2 = LockMonitor(levels={"A": 1, "B": 2})
+    mon2.instrument(obj2, "lock_a", "A")
+    mon2.instrument(obj2, "lock_b", "B")
+    with pytest.raises(InvariantViolation, match="level inversion"):
+        with obj2.lock_b:
+            with obj2.lock_a:
+                pass
+
+
+def test_lock_monitor_detects_cycle_against_static_graph():
+    obj = _Locked()
+    mon = LockMonitor(static_edges={("A", "B")})  # documented order: A -> B
+    mon.instrument(obj, "lock_a", "A")
+    mon.instrument(obj, "lock_b", "B")
+    with pytest.raises(InvariantViolation, match="cyclic"):
+        with obj.lock_b:
+            with obj.lock_a:  # runtime B -> A closes the cycle
+                pass
+
+
+# ---------------------------------------------------------------------------
+# real scenarios: one directed schedule each (full trees are the CI mc step)
+# ---------------------------------------------------------------------------
+
+
+def test_generate_ack_default_schedule_is_clean():
+    run = run_one(scenarios.get("generate_ack"), max_steps=60)
+    assert run.violation is None, run.violation
+
+
+def test_generate_ack_lost_reply_does_not_lose_tokens():
+    trace = ["submit:c1", "step", "poll_lost:c1", "poll:c1", "poll:c1"]
+    run = run_one(scenarios.get("generate_ack"), trace)
+    assert run.violation is None, run.violation
+
+
+def test_generate_ack_buggy_fixture_loses_a_token():
+    run = run_one(
+        scenarios.get("generate_ack_buggy"), ["submit:c1", "step", "poll_dup:c1"]
+    )
+    assert run.violation is not None
+    assert run.violation.invariant == "exactly-once-complete"
+
+
+def test_sdfs_default_schedule_is_clean():
+    run = run_one(scenarios.get("sdfs_put_crash_heal"), max_steps=20)
+    assert run.violation is None, run.violation
+
+
+def test_sdfs_rot_then_get_falls_back_to_clean_replica():
+    run = run_one(
+        scenarios.get("sdfs_put_crash_heal"),
+        ["boot", "rot:m0", "get", "scrub:m0", "heal", "get"],
+    )
+    assert run.violation is None, run.violation
+
+
+def test_breaker_default_schedule_is_clean():
+    run = run_one(scenarios.get("breaker"), max_steps=20)
+    assert run.violation is None, run.violation
+
+
+def test_membership_single_walk_converges():
+    run = run_one(scenarios.get("membership_converge"), rng=None, max_steps=60)
+    assert run.violation is None, run.violation
+
+
+def test_registry_names():
+    assert set(scenarios.names()) >= {
+        "breaker", "generate_ack", "generate_ack_buggy",
+        "membership_converge", "sdfs_put_crash_heal",
+    }
+
+
+def test_duplicate_injection_requires_idempotent_verb():
+    from dmlc_tpu.cluster.rpc import IDEMPOTENT_VERBS
+
+    assert "job.generate_poll" in IDEMPOTENT_VERBS
+    assert "sdfs.fetch_chunk" in IDEMPOTENT_VERBS

@@ -1,0 +1,59 @@
+"""Committed counterexample replays (tools/mc/repros/*.json).
+
+Every committed repro must keep reproducing its pinned invariant violation,
+byte-deterministically, forever — that is the whole point of committing it
+(docs/MODELCHECK.md). A repro against a ``*_buggy`` fixture scenario
+additionally proves the FIX: the identical schedule replayed against the
+non-buggy twin must run clean.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tools.mc import repro as repro_mod
+from tools.mc import scenarios
+from tools.mc.core import run_one
+
+COMMITTED = repro_mod.committed()
+
+
+def test_at_least_one_repro_is_committed():
+    assert COMMITTED, "tools/mc/repros/ must hold the seeded fixture repro"
+
+
+@pytest.mark.parametrize("path", COMMITTED, ids=lambda p: p.name)
+def test_repro_still_reproduces(path):
+    doc = repro_mod.load(path)
+    run = repro_mod.replay(doc)
+    assert run.violation is not None, (
+        f"{path.name} no longer reproduces — the bug it pins is gone; "
+        "delete the repro (or rename *.fixed.json as evidence) deliberately"
+    )
+    assert run.violation.invariant == doc["invariant"]
+
+
+@pytest.mark.parametrize("path", COMMITTED, ids=lambda p: p.name)
+def test_repro_is_deterministic(path):
+    doc = repro_mod.load(path)
+    r1, r2 = repro_mod.replay(doc), repro_mod.replay(doc)
+    assert r1.labels == r2.labels
+    assert str(r1.violation) == str(r2.violation)
+
+
+@pytest.mark.parametrize(
+    "path",
+    [p for p in COMMITTED if repro_mod.load(p)["scenario"].endswith("_buggy")],
+    ids=lambda p: p.name,
+)
+def test_buggy_fixture_schedule_is_clean_on_fixed_twin(path):
+    doc = repro_mod.load(path)
+    fixed = doc["scenario"][: -len("_buggy")]
+    run = run_one(
+        scenarios.get(fixed), doc["trace"],
+        max_steps=int(doc.get("max_steps", 200)), strict=False,
+    )
+    assert run.violation is None, (
+        f"schedule {doc['trace']} violates {run.violation} even on the "
+        f"fixed scenario {fixed!r}"
+    )

@@ -1,4 +1,4 @@
-"""Property-based tests (hypothesis) for the pure protocol cores.
+"""Property tests for the pure protocol cores, by exhaustive enumeration.
 
 The reference validated its membership logic with 3 hand-picked unit tests
 and manual VM kills (SURVEY.md §4); here the merge rule and ring topology
@@ -8,83 +8,109 @@ idempotent, commutative, associative — which is exactly what anti-entropy
 gossip needs for every node to converge to the same membership view
 regardless of delivery order (the reference's merge, membership.rs:302-327,
 was never checked for this).
+
+The input domain is small enough to enumerate COMPLETELY: 3 statuses x a
+coarse last_active grid (coarse on purpose — ties must be common enough to
+exercise the rank-based tie-break, not just the last_active comparison)
+gives 12 distinct Members, so the laws below are checked over every pair
+(144) and every triple (1728), a stronger guarantee than sampling. The
+randomized pieces (permutations, ring id sets) run under fixed seeds.
 """
 
 from __future__ import annotations
 
-import hypothesis.strategies as st
-from hypothesis import given, settings
+import itertools
+import random
+import string
+
+import pytest
 
 from dmlc_tpu.cluster.membership import Member, Status, merge_entry
 from dmlc_tpu.utils.ring import symmetric_ring_neighbors
 
-members = st.builds(
-    Member,
-    status=st.sampled_from(list(Status)),
-    # A coarse grid on purpose: ties must be common enough to exercise the
-    # rank-based tie-break, not just the last_active comparison.
-    last_active=st.integers(min_value=0, max_value=3).map(float),
-)
+#: the full (coarse) input domain for merge_entry
+MEMBERS = [
+    Member(status, float(last_active))
+    for status in Status
+    for last_active in range(4)
+]
 
 
 def join(a: Member, b: Member) -> Member:
     return merge_entry(a, b)
 
 
-@given(members)
-def test_merge_idempotent(a):
-    assert join(a, a) == a
+def test_merge_idempotent():
+    for a in MEMBERS:
+        assert join(a, a) == a
 
 
-@given(members, members)
-def test_merge_commutative(a, b):
-    assert join(a, b) == join(b, a)
+def test_merge_commutative():
+    for a, b in itertools.product(MEMBERS, repeat=2):
+        assert join(a, b) == join(b, a), (a, b)
 
 
-@given(members, members, members)
-def test_merge_associative(a, b, c):
-    assert join(join(a, b), c) == join(a, join(b, c))
+def test_merge_associative():
+    for a, b, c in itertools.product(MEMBERS, repeat=3):
+        assert join(join(a, b), c) == join(a, join(b, c)), (a, b, c)
 
 
-@given(members, st.lists(members, max_size=6), st.randoms())
-@settings(max_examples=200)
-def test_merge_order_free_convergence(seed, updates, rng):
+@pytest.mark.parametrize("seed", range(20))
+def test_merge_order_free_convergence(seed):
     """Folding any permutation of the same updates yields the same entry —
     the end-to-end consequence of the semilattice laws for gossip."""
-    a = list(updates)
-    rng.shuffle(a)
-    acc_1, acc_2 = seed, seed
+    rng = random.Random(seed)
+    start = rng.choice(MEMBERS)
+    updates = [rng.choice(MEMBERS) for _ in range(rng.randrange(7))]
+    shuffled = list(updates)
+    rng.shuffle(shuffled)
+    acc_1, acc_2 = start, start
     for x in updates:
         acc_1 = join(acc_1, x)
-    for x in a:
+    for x in shuffled:
         acc_2 = join(acc_2, x)
     assert acc_1 == acc_2
 
 
-@given(members, members)
-def test_merge_never_resurrects(a, b):
+def test_merge_never_resurrects():
     """An equally-fresh ACTIVE can never displace a FAILED/LEFT verdict."""
-    if a.status != Status.ACTIVE and b.status == Status.ACTIVE and b.last_active <= a.last_active:
-        assert join(a, b) == a
+    for a, b in itertools.product(MEMBERS, repeat=2):
+        if (
+            a.status != Status.ACTIVE
+            and b.status == Status.ACTIVE
+            and b.last_active <= a.last_active
+        ):
+            assert join(a, b) == a, (a, b)
 
 
-ids = st.lists(
-    st.tuples(st.text(st.characters(codec="ascii"), min_size=1, max_size=8), st.floats(0, 10)),
-    min_size=1,
-    max_size=20,
-    unique=True,
-)
+def _id_sets():
+    """Every ring size 1..4 over a tiny alphabet exhaustively, plus seeded
+    random larger rings — the shapes where window overlap and wraparound
+    bite."""
+    small = list(string.ascii_lowercase[:5])
+    for n in range(1, 5):
+        yield from itertools.combinations(small, n)
+    rng = random.Random(7)
+    for _ in range(25):
+        size = rng.randrange(5, 21)
+        yield tuple(
+            f"{rng.choice(string.ascii_lowercase)}{rng.randrange(100):02d}"
+            for _ in range(size)
+        )
 
 
-@given(ids, st.integers(min_value=1, max_value=4), st.data())
-def test_ring_neighbor_invariants(all_ids, k, data):
-    me = data.draw(st.sampled_from(all_ids))
-    neighbors = symmetric_ring_neighbors(all_ids, me, k)
-    assert me not in neighbors
-    assert len(neighbors) == len(set(neighbors))
-    assert set(neighbors) <= set(all_ids)
-    assert len(neighbors) <= 2 * k
-    # Symmetry: with a shared view, neighborhood is mutual — the property
-    # the failure detector's "only judge your own neighbors" rule rests on.
-    for n in neighbors:
-        assert me in symmetric_ring_neighbors(all_ids, n, k)
+def test_ring_neighbor_invariants():
+    for ids in _id_sets():
+        all_ids = list(dict.fromkeys(ids))
+        for k in range(1, 5):
+            for me in all_ids:
+                neighbors = symmetric_ring_neighbors(all_ids, me, k)
+                assert me not in neighbors
+                assert len(neighbors) == len(set(neighbors))
+                assert set(neighbors) <= set(all_ids)
+                assert len(neighbors) <= 2 * k
+                # Symmetry: with a shared view, neighborhood is mutual — the
+                # property the failure detector's "only judge your own
+                # neighbors" rule rests on.
+                for n in neighbors:
+                    assert me in symmetric_ring_neighbors(all_ids, n, k)

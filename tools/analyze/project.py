@@ -156,6 +156,10 @@ class Project:
         self.modules: dict[str, ModuleInfo] = {}
         self.classes: dict[str, ClassInfo] = {}
         self.errors: list[tuple[str, int, str]] = []  # (relpath, line, msg)
+        #: resolve_call memo — rules walk overlapping closures, so the same
+        #: call site is resolved many times; the AST (and hence id(call))
+        #: is stable for the project's lifetime
+        self._call_memo: dict[tuple[int, str], tuple["FuncDef | None", bool]] = {}
 
     # ---- construction ---------------------------------------------------
 
@@ -351,6 +355,15 @@ class Project:
         external / dynamic / unresolvable. Second element: True when the
         edge is a ``self.m()`` call into the context function's own class
         (lint L1 already follows those)."""
+        key = (id(call), ctx.qname)
+        hit = self._call_memo.get(key)
+        if hit is not None:
+            return hit
+        out = self._resolve_call(call, ctx)
+        self._call_memo[key] = out
+        return out
+
+    def _resolve_call(self, call: ast.Call, ctx: FuncDef) -> tuple[FuncDef | None, bool]:
         func = call.func
         mod = ctx.module
         if isinstance(func, ast.Attribute):

@@ -8,7 +8,14 @@ call-chain witnesses.
 
 from __future__ import annotations
 
-from tools.analyze.rules import blocking, devsem, frameschema, lockorder, propagation
+from tools.analyze.rules import (
+    blocking,
+    devsem,
+    frameschema,
+    lockorder,
+    propagation,
+    retrysafety,
+)
 
 RULES = [
     lockorder.A1,
@@ -19,4 +26,5 @@ RULES = [
     devsem.A6,
     devsem.A7,
     devsem.A8,
+    retrysafety.A9,
 ]

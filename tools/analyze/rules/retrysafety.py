@@ -1,0 +1,198 @@
+"""A9 — retry-safety: verbs dispatched under RetryPolicy must be idempotent.
+
+Wherever the code consults a ``retry_policy`` gate (``allow``/``allow_retry``,
+cluster/retrypolicy.py) it is because the same payload may be dispatched
+MORE THAN ONCE — a requeued shard on a fresh member, ``_pull_to`` walking
+to a fallback replica, the announce loop re-pushing each probe tick, a
+failover probe re-asking the next candidate. On the at-least-once fabric a
+retried verb whose handler is not idempotent double-applies its effect
+(docs/MODELCHECK.md's duplicate-delivery choice point is the dynamic twin
+of this rule).
+
+The registry is ``cluster/rpc.py``'s ``IDEMPOTENT_VERBS``: verb -> one-line
+justification. A verb dispatch is *retry-governed* when some function's
+reachable call graph contains both the dispatch and a retry gate; this rule
+flags every retry-governed string-literal verb missing from the registry.
+The same registry tells dmlc-mc where duplicate-delivery injection is
+legal, so a verb cannot be model-checked as retry-safe without being
+declared here — and cannot be declared here without the declaration being
+visible to review.
+
+Adding a verb to the registry IS the fix when the handler is genuinely
+idempotent (say why in the value); otherwise make the handler idempotent
+(dedup key, cumulative ack) or lift the dispatch out of the retried path.
+
+The rule summarizes each function once (local gates, local verb sites,
+resolved callees) and answers governance by set reachability over that
+graph; full witness chains are materialized only for actual findings, so
+the clean-tree cost is one ``resolve_call`` per call site.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+
+from dmlc_tpu.cluster.rpc import IDEMPOTENT_VERBS
+from tools.analyze.core import Analysis, Finding
+from tools.analyze.project import Step, iter_calls
+from tools.lint.rules import dotted_name
+
+#: retry-gate method names on a retry_policy receiver
+_GATES = ("allow", "allow_retry")
+
+
+def _gate_call(call: ast.Call) -> bool:
+    func = call.func
+    if not (isinstance(func, ast.Attribute) and func.attr in _GATES):
+        return False
+    receiver = dotted_name(func.value)
+    return receiver is not None and receiver.split(".")[-1] == "retry_policy"
+
+
+def _literal_verb(call: ast.Call) -> str | None:
+    """The verb of ``<...>.rpc.call(addr, "verb", ...)``, if literal."""
+    func = call.func
+    if not (isinstance(func, ast.Attribute) and func.attr == "call"):
+        return None
+    receiver = dotted_name(func.value)
+    if receiver is None or receiver.split(".")[-1] != "rpc":
+        return None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+            and isinstance(call.args[1].value, str):
+        return call.args[1].value
+    return None
+
+
+class _A9:
+    id = "A9"
+    summary = "retry-governed dispatch of a verb not in IDEMPOTENT_VERBS"
+    hint = ("register the verb in cluster/rpc.py IDEMPOTENT_VERBS with a "
+            "one-line justification if its handler really is idempotent; "
+            "otherwise make it so (dedup key / cumulative ack) or move the "
+            "dispatch off the retried path")
+
+    def check(self, analysis: Analysis) -> None:
+        project = analysis.project
+        # one summary pass: per function, its gate site, unregistered verb
+        # sites, and resolved callee edges (each call site resolved once)
+        edges: dict[str, list[tuple[str, Step]]] = {}
+        gate_sites: dict[str, tuple[str, int, str]] = {}
+        verb_sites: dict[str, list[tuple[str, int, int, str]]] = {}
+        for mod in sorted(project.modules.values(), key=lambda m: m.relpath):
+            for fd in project._all_funcs(mod):
+                q = fd.qname
+                if q in edges:
+                    continue
+                out = edges[q] = []
+                for call in iter_calls(fd.node.body):
+                    if _gate_call(call) and q not in gate_sites:
+                        gate_sites[q] = (
+                            mod.relpath, call.lineno, call.func.attr,
+                        )
+                        continue
+                    verb = _literal_verb(call)
+                    if verb is not None and verb not in IDEMPOTENT_VERBS:
+                        verb_sites.setdefault(q, []).append(
+                            (mod.relpath, call.lineno, call.col_offset, verb)
+                        )
+                    callee, is_self = project.resolve_call(call, fd)
+                    if callee is None or callee.qname == q:
+                        continue
+                    desc = (dotted_name(call.func)
+                            or getattr(call.func, "attr", "?"))
+                    label = callee.qname[len(project.package) + 1:]
+                    out.append((callee.qname, Step(
+                        mod.relpath, call.lineno, f"{desc}()  [{label}]",
+                        is_self,
+                    )))
+        if not gate_sites or not verb_sites:
+            return
+
+        rev: dict[str, set[str]] = defaultdict(set)
+        for q, outs in edges.items():
+            for cq, _ in outs:
+                rev[cq].add(q)
+        # G: functions whose reachable closure contains a retry gate
+        # (backward closure of the gate holders)
+        g_set = set(gate_sites)
+        stack = list(g_set)
+        while stack:
+            for p in rev.get(stack.pop(), ()):
+                if p not in g_set:
+                    g_set.add(p)
+                    stack.append(p)
+        # governed: functions sharing a root with a gate = forward closure
+        # of G (a verb site here is re-dispatchable under retry)
+        governed = set(g_set)
+        stack = list(g_set)
+        while stack:
+            for cq, _ in edges.get(stack.pop(), ()):
+                if cq not in governed:
+                    governed.add(cq)
+                    stack.append(cq)
+
+        reported: set[tuple[str, int]] = set()
+        chain_cache: dict[str, dict[str, tuple[Step, ...]]] = {}
+        for q in sorted(q for q in verb_sites if q in governed):
+            root = self._nearest_root(q, g_set, rev)
+            chains = chain_cache.setdefault(
+                root, self._chains_from(root, edges)
+            )
+            gate_q = min(
+                (g for g in gate_sites if g in chains),
+                key=lambda g: len(chains[g]),
+            )
+            g_rel, g_line, g_name = gate_sites[gate_q]
+            gate_chain = chains[gate_q] + (Step(
+                g_rel, g_line,
+                f"consults the retry gate retry_policy.{g_name}()", False,
+            ),)
+            for rel, line, col, verb in verb_sites[q]:
+                if (rel, line) in reported:
+                    continue
+                reported.add((rel, line))
+                analysis.findings.append(Finding(
+                    rel, line, col, self.id,
+                    f"verb {verb!r} is dispatched from a retry-governed "
+                    f"path ({root}) but is not registered idempotent "
+                    "(cluster/rpc.py IDEMPOTENT_VERBS)",
+                    gate_chain + chains[q],
+                ))
+
+    @staticmethod
+    def _nearest_root(q: str, g_set: set[str], rev: dict[str, set[str]]) -> str:
+        """The closest function (q itself or a caller, BFS) whose closure
+        contains a gate — the best witness root for q's dispatches."""
+        seen = {q}
+        frontier = [q]
+        while frontier:
+            for cand in frontier:
+                if cand in g_set:
+                    return cand
+            frontier = [
+                p for cand in frontier for p in sorted(rev.get(cand, ()))
+                if p not in seen and not seen.add(p)
+            ]
+        return q  # unreachable for governed q; be safe
+
+    @staticmethod
+    def _chains_from(
+        root: str, edges: dict[str, list[tuple[str, Step]]]
+    ) -> dict[str, tuple[Step, ...]]:
+        """Shortest Step-chain from ``root`` to every reachable function."""
+        chains: dict[str, tuple[Step, ...]] = {root: ()}
+        frontier = [root]
+        while frontier:
+            nxt: list[str] = []
+            for q in frontier:
+                for cq, step in edges.get(q, ()):
+                    if cq in chains:
+                        continue
+                    chains[cq] = chains[q] + (step,)
+                    nxt.append(cq)
+            frontier = nxt
+        return chains
+
+
+A9 = _A9()

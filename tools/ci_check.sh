@@ -3,43 +3,54 @@
 #
 #   1. static analysis  — dmlc-lint (file-local invariants, tools/lint)
 #                         + dmlc-analyze (whole-program concurrency,
-#                         protocol, and device-semantics rules A1-A8,
+#                         protocol, and device-semantics rules A1-A9,
 #                         tools/analyze), gated through the findings
 #                         ratchet (tools/ratchet.py vs the committed
 #                         tools/analysis_baseline.json): any finding not
 #                         in the baseline fails; entries that stop firing
 #                         warn so the baseline only shrinks
-#   2. ruff             — generic Python lint (ruff.toml)
-#   3. mypy --strict    — types, strict on dmlc_tpu/cluster/,
+#   2. model checker    — dmlc-mc (tools/mc, docs/MODELCHECK.md): bounded
+#                         exhaustive DPOR exploration of the 2-node
+#                         protocol scenarios (breaker, SDFS put/crash/heal,
+#                         generate exactly-once ack) + a seeded random-walk
+#                         leg on the 3-node membership tree (walk seeds
+#                         offset by DMLC_CHAOS_SEED, like the chaos
+#                         matrix); wall-clock capped inside tools/mc ci.
+#                         Violations are shrunk to minimal schedules and
+#                         gated through the same ratchet (--mc-findings),
+#                         so a new interleaving bug fails the build with a
+#                         replayable witness
+#   3. ruff             — generic Python lint (ruff.toml)
+#   4. mypy --strict    — types, strict on dmlc_tpu/cluster/,
 #                         dmlc_tpu/generate/, and
 #                         dmlc_tpu/scheduler/placement.py (incremental
 #                         adoption: other packages are not yet
 #                         annotation-complete)
-#   4. clang-tidy       — native/*.cpp static analysis (.clang-tidy)
-#   5. native build     — the production .so (persistent decode pool)
+#   5. clang-tidy       — native/*.cpp static analysis (.clang-tidy)
+#   6. native build     — the production .so (persistent decode pool)
 #                         must compile from source
-#   6. sanitizer smoke  — make sanitize + ASan/TSan decode over corrupt
+#   7. sanitizer smoke  — make sanitize + ASan/TSan decode over corrupt
 #                         JPEG fixtures through the PERSISTENT pool, incl.
 #                         concurrent submitters and pool shutdown/regrow
 #                         (tests/test_native_sanitize.py)
-#   7. trace smoke      — real localcluster run with tracing on: the
+#   8. trace smoke      — real localcluster run with tracing on: the
 #                         merged Perfetto JSON must load and spans from
 #                         >= 2 nodes must share one trace_id with correct
 #                         parent ordering (tools/trace_smoke.py)
-#   8. bench guard      — the committed bench_detail.json must keep every
+#   9. bench guard      — the committed bench_detail.json must keep every
 #                         section README/PARITY cite, including the
 #                         device-plane ledger (compile census, peak HBM,
 #                         MFU vs roofline) with every MFU a ratio in
 #                         (0, 1] — an MFU regression or a malformed
 #                         device capture fails here, machine-visibly
 #                         (tests/test_bench_guard.py)
-#   9. loadgen smoke    — seeded flash-crowd replay through the sim fleet
+#  10. loadgen smoke    — seeded flash-crowd replay through the sim fleet
 #                         (tools/slo_cert.py): fails unless slo_cert.json
 #                         validates against the schema, error traces were
 #                         force-sampled into the merged fleet trace, and
 #                         leader scrape cost held the 4*sqrt(N) tree
 #                         bound; one leg per chaos seed base
-#  10. chaos matrix     — the seeded fault-injection suites (crashes,
+#  11. chaos matrix     — the seeded fault-injection suites (crashes,
 #                         partitions, failover, disk bit-rot/torn writes,
 #                         overload: deadlines/shedding/breakers/gray
 #                         ejection, the generation join/leave soak with
@@ -64,6 +75,21 @@ if python -m tools.ratchet; then
   note "static analysis OK (no findings outside the committed baseline)"
 else
   note "static analysis FAILED (new findings above; fix or justify-suppress, docs/LINT.md + docs/ANALYZE.md)"
+  fail=1
+fi
+
+note "model checker (dmlc-mc: exhaustive 2-node scenarios + seeded membership walks, docs/MODELCHECK.md)"
+MC_SEED="${DMLC_CHAOS_SEED:-0}"
+MC_JSON="/tmp/mc_findings_$MC_SEED.json"
+if env JAX_PLATFORMS=cpu python -m tools.mc ci --seed "$MC_SEED" --json "$MC_JSON"; then
+  if python -m tools.ratchet --mc-findings "$MC_JSON"; then
+    note "model checker OK (no violations outside the committed baseline)"
+  else
+    note "model checker FAILED (shrunk schedules above; replay: python -m tools.mc replay <repro.json>)"
+    fail=1
+  fi
+else
+  note "model checker FAILED to run (tool error)"
   fail=1
 fi
 

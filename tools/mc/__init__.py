@@ -1,0 +1,37 @@
+"""dmlc-mc: deterministic-schedule model checking for the cluster protocols.
+
+The simulator fabrics (``SimRpcNetwork``, ``SimNetwork``, ``SimClock``) made
+the cluster code *runnable* without wall clocks or sockets; dmlc-mc makes it
+*explorable*. Every nondeterministic decision the deployment environment
+takes implicitly — which in-flight message lands next, which timer fires,
+whether a process dies at a durability seam, whether an at-least-once frame
+is delivered twice — becomes an explicit labeled choice a deterministic
+explorer controls (docs/MODELCHECK.md):
+
+- ``core``      — the choice-tree explorer: bounded exhaustive DFS with
+                  sleep-set/DPOR-style pruning over event footprints, plus a
+                  seeded random-walk mode for CI budgets.
+- ``shrink``    — delta-debugging of violating schedules down to minimal
+                  repros.
+- ``repro``     — the committed ``tools/mc/repros/*.json`` schedule format
+                  and its byte-deterministic pytest replay.
+- ``locks``     — runtime assertion of the documented lock hierarchy
+                  (dmlc-analyze's static lock graph, enforced on the
+                  acquisitions a schedule actually performs).
+- ``scenarios`` — the worlds: real cluster code (sdfs.py, generate/,
+                  retrypolicy.py, membership.py) behind choice-point seams.
+
+Run it: ``python -m tools.mc explore --scenario sdfs_put_crash_heal``.
+"""
+
+from tools.mc.core import (  # noqa: F401
+    Choice,
+    Event,
+    ExploreResult,
+    InvariantViolation,
+    MCFinding,
+    RunResult,
+    explore,
+    random_walks,
+    run_one,
+)

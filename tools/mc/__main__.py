@@ -1,0 +1,176 @@
+"""dmlc-mc CLI.
+
+    python -m tools.mc list
+    python -m tools.mc explore --scenario sdfs_put_crash_heal [--shrink]
+    python -m tools.mc random  --scenario membership_converge --walks 200 --seed 0
+    python -m tools.mc replay  tools/mc/repros/generate_ack_buggy.json
+    python -m tools.mc ci      --seed 0 --json /tmp/mc.json
+
+``explore`` is the bounded exhaustive mode (DPOR-pruned). ``random`` is the
+seeded walk mode for trees too wide to exhaust. ``replay`` re-runs a
+committed repro and reports whether it still reproduces. ``ci`` is the
+ci_check.sh entry point: exhaustive on the 2-node scenarios, seeded walks
+on the 3-node membership tree, findings emitted as JSON for
+tools/ratchet.py. Exit codes: 0 = ran (findings, if any, are the ratchet's
+problem), 2 = tool error. ``replay`` exits 1 when the repro no longer
+reproduces.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+from typing import Any
+
+from tools.mc import repro as repro_mod
+from tools.mc import scenarios
+from tools.mc.core import ExploreResult, explore, random_walks
+from tools.mc.shrink import shrink
+
+#: per-scenario exhaustive caps for the CI leg: generous next to the
+#: observed tree sizes, hard stops if a seam change blows a tree up
+CI_EXHAUSTIVE = ("breaker", "sdfs_put_crash_heal", "generate_ack")
+CI_MAX_SCHEDULES = 60_000
+CI_TIME_BUDGET_S = 120.0
+CI_WALKS = 150
+
+
+def _emit(results: list[ExploreResult], path: str | None) -> None:
+    doc: dict[str, Any] = {
+        "results": [
+            {
+                "scenario": r.scenario,
+                "schedules": r.schedules,
+                "pruned": r.pruned,
+                "max_depth": r.max_depth,
+                "elapsed_s": round(r.elapsed_s, 3),
+                "exhausted": r.exhausted,
+            }
+            for r in results
+        ],
+        "findings": [f.to_json() for r in results for f in r.findings],
+    }
+    if path:
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+    for r in results:
+        print(r.summary())
+    for f in doc["findings"]:
+        print(f"VIOLATION [{f['scenario']}] {f['invariant']}: {f['message']}")
+        print(f"  schedule: {f['trace']}")
+
+
+def _shrink_findings(results: list[ExploreResult]) -> None:
+    for r in results:
+        for f in r.findings:
+            f.trace = shrink(
+                scenarios.get(f.scenario), f.trace, f.invariant
+            )
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.mc")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("list", help="registered scenarios")
+
+    ex = sub.add_parser("explore", help="bounded exhaustive exploration")
+    ex.add_argument("--scenario", required=True, choices=scenarios.names())
+    ex.add_argument("--max-steps", type=int, default=200)
+    ex.add_argument("--no-dpor", action="store_true")
+    ex.add_argument("--max-schedules", type=int, default=None)
+    ex.add_argument("--time-budget", type=float, default=None)
+    ex.add_argument("--shrink", action="store_true",
+                    help="delta-debug each finding's schedule")
+    ex.add_argument("--repro-out", default=None,
+                    help="write the first shrunk finding as a repro JSON")
+    ex.add_argument("--json", default=None, help="results JSON path")
+
+    rd = sub.add_parser("random", help="seeded random walks")
+    rd.add_argument("--scenario", required=True, choices=scenarios.names())
+    rd.add_argument("--walks", type=int, default=CI_WALKS)
+    rd.add_argument("--seed", type=int, default=0)
+    rd.add_argument("--max-steps", type=int, default=200)
+    rd.add_argument("--shrink", action="store_true")
+    rd.add_argument("--json", default=None)
+
+    rp = sub.add_parser("replay", help="replay a committed repro")
+    rp.add_argument("path")
+
+    ci = sub.add_parser("ci", help="the bounded ci_check.sh leg")
+    ci.add_argument("--seed", type=int, default=0)
+    ci.add_argument("--json", default=None)
+
+    args = ap.parse_args(argv)
+    # The cluster code logs every injected fault it survives — thousands of
+    # schedules of that is noise here; violations are the signal.
+    logging.disable(logging.WARNING)
+
+    if args.cmd == "list":
+        for name in scenarios.names():
+            print(name)
+        return 0
+
+    if args.cmd == "explore":
+        result = explore(
+            scenarios.get(args.scenario),
+            max_steps=args.max_steps,
+            dpor=not args.no_dpor,
+            max_schedules=args.max_schedules,
+            time_budget_s=args.time_budget,
+        )
+        if args.shrink or args.repro_out:
+            _shrink_findings([result])
+        if args.repro_out and result.findings:
+            doc = repro_mod.to_doc(result.findings[0], max_steps=args.max_steps)
+            repro_mod.save(doc, args.repro_out)
+            print(f"repro written: {args.repro_out}")
+        _emit([result], args.json)
+        return 0
+
+    if args.cmd == "random":
+        result = random_walks(
+            scenarios.get(args.scenario),
+            walks=args.walks, seed=args.seed, max_steps=args.max_steps,
+        )
+        if args.shrink:
+            _shrink_findings([result])
+        _emit([result], args.json)
+        return 0
+
+    if args.cmd == "replay":
+        doc = repro_mod.load(args.path)
+        run = repro_mod.replay(doc)
+        if run.violation is not None and run.violation.invariant == doc["invariant"]:
+            print(f"REPRODUCES {doc['scenario']}/{doc['invariant']}: "
+                  f"{run.violation.message}")
+            print(f"  schedule: {run.labels}")
+            return 0
+        state = ("different violation: " + str(run.violation)
+                 if run.violation else "clean run")
+        print(f"no longer reproduces ({state})")
+        return 1
+
+    if args.cmd == "ci":
+        results = []
+        for name in CI_EXHAUSTIVE:
+            results.append(explore(
+                scenarios.get(name),
+                max_schedules=CI_MAX_SCHEDULES,
+                time_budget_s=CI_TIME_BUDGET_S,
+            ))
+        results.append(random_walks(
+            scenarios.get("membership_converge"),
+            walks=CI_WALKS, seed=args.seed,
+        ))
+        _shrink_findings(results)
+        _emit(results, args.json)
+        return 0
+
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,315 @@
+"""The choice-tree explorer: bounded exhaustive DFS + seeded random walks.
+
+A *scenario* builds a fresh, fully deterministic *world* per execution; the
+world exposes its nondeterminism as a list of labeled enabled ``Event``s
+(message deliveries, timer firings, crash points, duplicate deliveries,
+virtual-clock advances). One *execution* repeatedly asks the world for its
+enabled events, picks one, fires it, and runs every invariant — so a
+schedule IS a sequence of labels, and replaying the label sequence replays
+the execution byte-for-byte (docs/MODELCHECK.md).
+
+The exhaustive mode is stateless model checking: run a schedule to
+completion under a prefix-directed chooser (beyond the prefix, always the
+first enabled label), then branch on every unexplored alternative at every
+decision point past the prefix. Each node of the choice tree is visited
+exactly once.
+
+Pruning is sleep-set/DPOR-flavored, keyed on event *footprints* (the state
+an event touches, declared by the world): an alternative ``alt`` at
+position ``i`` is skipped when the executed schedule picked ``alt`` later
+at position ``j`` and every event fired in between is independent of it
+(disjoint, non-empty footprints) — firing ``alt`` first then commutes with
+the explored schedule into the same state, so the branch is Mazurkiewicz-
+equivalent to one already covered. Events with an empty footprint are
+conservatively dependent on everything. ``--no-dpor`` turns the pruning
+off so the equivalence can be cross-checked on small trees
+(tests/test_mc.py does).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Protocol
+
+
+class InvariantViolation(AssertionError):
+    """An invariant failed after an event fired. ``invariant`` names the
+    broken property; the message is the human-readable evidence."""
+
+    def __init__(self, invariant: str, message: str):
+        super().__init__(f"{invariant}: {message}")
+        self.invariant = invariant
+        self.message = message
+
+
+@dataclass
+class Event:
+    """One enabled choice: a label (stable across executions — the schedule
+    vocabulary), the state transition, and the footprint DPOR keys
+    independence on. An empty footprint means "touches everything"."""
+
+    label: str
+    fire: Callable[[], None]
+    footprint: frozenset[str] = frozenset()
+
+
+@dataclass(frozen=True)
+class Choice:
+    """One recorded decision: what was picked, out of what."""
+
+    picked: str
+    options: tuple[str, ...]
+    footprint: tuple[str, ...] = ()
+
+
+class World(Protocol):
+    def enabled(self) -> list[Event]: ...
+    def invariants(self) -> list[tuple[str, Callable[[], None]]]: ...
+    def close(self) -> None: ...
+
+
+class Scenario(Protocol):
+    name: str
+
+    def build(self) -> World: ...
+
+
+@dataclass
+class RunResult:
+    trace: list[Choice]
+    violation: InvariantViolation | None
+    steps: int
+
+    @property
+    def labels(self) -> list[str]:
+        return [c.picked for c in self.trace]
+
+
+@dataclass
+class MCFinding:
+    """One distinct violation, with the (possibly shrunk) witness schedule.
+    The ratchet key is (scenario, invariant, message) — the trace is the
+    derived witness, like dmlc-analyze's chains."""
+
+    scenario: str
+    invariant: str
+    message: str
+    trace: list[str] = field(default_factory=list)
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "scenario": self.scenario,
+            "invariant": self.invariant,
+            "message": self.message,
+            "trace": list(self.trace),
+        }
+
+
+@dataclass
+class ExploreResult:
+    scenario: str
+    schedules: int = 0
+    pruned: int = 0
+    max_depth: int = 0
+    elapsed_s: float = 0.0
+    exhausted: bool = True  # False when a cap (schedules/time) cut it short
+    findings: list[MCFinding] = field(default_factory=list)
+
+    def summary(self) -> str:
+        mode = "exhausted" if self.exhausted else "capped"
+        return (
+            f"{self.scenario}: {self.schedules} schedules ({mode}), "
+            f"{self.pruned} branches pruned, depth<={self.max_depth}, "
+            f"{len(self.findings)} violation(s), {self.elapsed_s:.1f}s"
+        )
+
+
+class ScheduleDivergence(RuntimeError):
+    """Strict replay hit a prefix label the world did not enable — the
+    scenario is not deterministic (or the prefix came from another world)."""
+
+
+def run_one(
+    scenario: Scenario,
+    prefix: Iterable[str] = (),
+    *,
+    max_steps: int = 200,
+    rng: random.Random | None = None,
+    strict: bool = True,
+) -> RunResult:
+    """Execute one schedule. The ``prefix`` labels are consumed in order;
+    past it (or, non-strict, around entries that are not currently enabled)
+    the chooser takes the first enabled label — or a seeded-random one when
+    ``rng`` is given. Invariants run after every fired event; the first
+    violation ends the execution with the trace as its witness."""
+    prefix = list(prefix)
+    world = scenario.build()
+    trace: list[Choice] = []
+    violation: InvariantViolation | None = None
+    p = 0
+    try:
+        for _ in range(max_steps):
+            events = world.enabled()
+            if not events:
+                break
+            labels = [e.label for e in events]
+            picked_i: int | None = None
+            if p < len(prefix):
+                if strict:
+                    if prefix[p] not in labels:
+                        raise ScheduleDivergence(
+                            f"step {len(trace)}: prefix wants {prefix[p]!r}, "
+                            f"world enables {labels}"
+                        )
+                    picked_i = labels.index(prefix[p])
+                    p += 1
+                else:
+                    # Loose replay (shrinking, committed repros): take the
+                    # FIRST remaining prefix entry that is enabled now; a
+                    # shrunk-away dependency must not wedge the pointer.
+                    for q in range(p, len(prefix)):
+                        if prefix[q] in labels:
+                            picked_i = labels.index(prefix[q])
+                            p = q + 1
+                            break
+            if picked_i is None:
+                picked_i = rng.randrange(len(labels)) if rng is not None else 0
+            ev = events[picked_i]
+            trace.append(
+                Choice(ev.label, tuple(labels), tuple(sorted(ev.footprint)))
+            )
+            try:
+                ev.fire()
+                for name, check in world.invariants():
+                    check()
+            except InvariantViolation as v:
+                violation = v
+                break
+            except ScheduleDivergence:
+                raise
+            except Exception as e:
+                # A raw exception escaping an event is itself a finding: the
+                # cluster code crashed under a legal schedule.
+                violation = InvariantViolation(
+                    "uncaught-exception", f"{type(e).__name__}: {e}"
+                )
+                break
+        return RunResult(trace, violation, len(trace))
+    finally:
+        world.close()
+
+
+def _independent(a: Iterable[str], b: Iterable[str]) -> bool:
+    fa, fb = frozenset(a), frozenset(b)
+    if not fa or not fb:
+        return False  # empty footprint = touches everything
+    return not (fa & fb)
+
+
+def _alternatives(trace: list[Choice], i: int, dpor: bool) -> list[str]:
+    """Unexplored branches at decision ``i`` of an executed schedule,
+    minus the ones sleep-set pruning proves equivalent."""
+    ch = trace[i]
+    alts = []
+    for alt in ch.options:
+        if alt == ch.picked:
+            continue
+        if dpor:
+            j = next(
+                (k for k in range(i + 1, len(trace))
+                 if trace[k].picked == alt),
+                None,
+            )
+            if j is not None and all(
+                _independent(trace[k].footprint, trace[j].footprint)
+                for k in range(i, j)
+            ):
+                continue  # alt commutes up to its actual firing: equivalent
+        alts.append(alt)
+    return alts
+
+
+def explore(
+    scenario: Scenario,
+    *,
+    max_steps: int = 200,
+    dpor: bool = True,
+    max_schedules: int | None = None,
+    time_budget_s: float | None = None,
+    max_findings: int = 16,
+) -> ExploreResult:
+    """Bounded exhaustive search over the scenario's choice tree.
+
+    Violations do not stop the search (the tree may hide distinct bugs);
+    findings are deduplicated by (invariant, message) and each keeps the
+    first witness schedule. ``max_schedules`` / ``time_budget_s`` cap CI
+    cost — ``exhausted`` reports whether the tree was fully covered."""
+    t0 = time.monotonic()
+    result = ExploreResult(scenario.name)
+    seen: set[tuple[str, str]] = set()
+    stack: list[list[str]] = [[]]
+    pruned = 0
+    while stack:
+        if max_schedules is not None and result.schedules >= max_schedules:
+            result.exhausted = False
+            break
+        if (
+            time_budget_s is not None
+            and time.monotonic() - t0 > time_budget_s
+        ):
+            result.exhausted = False
+            break
+        prefix = stack.pop()
+        run = run_one(scenario, prefix, max_steps=max_steps)
+        result.schedules += 1
+        result.max_depth = max(result.max_depth, run.steps)
+        if run.violation is not None:
+            key = (run.violation.invariant, run.violation.message)
+            if key not in seen and len(result.findings) < max_findings:
+                seen.add(key)
+                result.findings.append(MCFinding(
+                    scenario.name, run.violation.invariant,
+                    run.violation.message, run.labels,
+                ))
+        for i in range(len(prefix), len(run.trace)):
+            kept = _alternatives(run.trace, i, dpor)
+            pruned += len(run.trace[i].options) - 1 - len(kept)
+            base = [c.picked for c in run.trace[:i]]
+            for alt in kept:
+                stack.append(base + [alt])
+    result.pruned = pruned
+    result.elapsed_s = time.monotonic() - t0
+    return result
+
+
+def random_walks(
+    scenario: Scenario,
+    *,
+    walks: int,
+    seed: int,
+    max_steps: int = 200,
+    max_findings: int = 16,
+) -> ExploreResult:
+    """Seeded random-walk mode: ``walks`` independent schedules, each from
+    its own derived seed, so one CI leg samples a reproducible slice of the
+    tree (re-run any single walk with the same seed to get its schedule)."""
+    t0 = time.monotonic()
+    result = ExploreResult(scenario.name)
+    seen: set[tuple[str, str]] = set()
+    for w in range(walks):
+        rng = random.Random(seed * 1_000_003 + w)
+        run = run_one(scenario, rng=rng, max_steps=max_steps)
+        result.schedules += 1
+        result.max_depth = max(result.max_depth, run.steps)
+        if run.violation is not None:
+            key = (run.violation.invariant, run.violation.message)
+            if key not in seen and len(result.findings) < max_findings:
+                seen.add(key)
+                result.findings.append(MCFinding(
+                    scenario.name, run.violation.invariant,
+                    run.violation.message, run.labels,
+                ))
+    result.elapsed_s = time.monotonic() - t0
+    return result

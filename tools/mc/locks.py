@@ -1,0 +1,171 @@
+"""Runtime assertion of the documented lock hierarchy.
+
+dmlc-analyze's A1 publishes the static held-while-acquiring graph
+(``Analysis.lock_edges``, identities class-qualified as ``pkg.mod.Cls.attr``).
+This module enforces the same hierarchy on the acquisitions a model-checked
+schedule ACTUALLY performs: ``LockMonitor.instrument`` replaces a lock (or
+condition) attribute on a live object with a recording proxy; every acquire
+while another instrumented lock is held adds a runtime ``outer -> inner``
+edge, and an edge that closes a cycle in the combined static∪runtime graph —
+or inverts an explicit level assignment — raises
+``InvariantViolation("lock-hierarchy")`` with the offending chain.
+
+The runtime side catches what the static side cannot resolve (locks reached
+through duck-typed backends, callbacks, or data-driven dispatch), and the
+static side supplies the edges a particular schedule did not happen to
+exercise — each closes the other's blind spot.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from tools.mc.core import InvariantViolation
+
+
+class _LockProxy:
+    """Wraps a Lock/RLock/Condition; forwards everything, reports acquires
+    and releases to the monitor. ``with``-statement and explicit
+    acquire/release both funnel through the same two hooks."""
+
+    def __init__(self, inner: Any, name: str, monitor: "LockMonitor"):
+        self._mc_inner = inner
+        self._mc_name = name
+        self._mc_monitor = monitor
+
+    def acquire(self, *args: Any, **kwargs: Any) -> Any:
+        got = self._mc_inner.acquire(*args, **kwargs)
+        if got:
+            self._mc_monitor._acquired(self._mc_name)
+        return got
+
+    def release(self, *args: Any, **kwargs: Any) -> Any:
+        self._mc_monitor._released(self._mc_name)
+        return self._mc_inner.release(*args, **kwargs)
+
+    def __enter__(self) -> Any:
+        got = self._mc_inner.__enter__()
+        self._mc_monitor._acquired(self._mc_name)
+        return got
+
+    def __exit__(self, *exc: Any) -> Any:
+        self._mc_monitor._released(self._mc_name)
+        return self._mc_inner.__exit__(*exc)
+
+    def __getattr__(self, attr: str) -> Any:
+        # wait()/notify()/locked()/... pass straight through. Condition.wait
+        # releases and reacquires internally without changing what the
+        # *caller* holds, so the held-stack stays put — correct for ordering.
+        return getattr(self._mc_inner, attr)
+
+
+class LockMonitor:
+    """Collects runtime acquisition edges and checks them against the
+    documented hierarchy after every event."""
+
+    def __init__(
+        self,
+        static_edges: set[tuple[str, str]] | None = None,
+        levels: dict[str, int] | None = None,
+    ):
+        self.static_edges = set(static_edges or ())
+        self.levels = dict(levels or {})
+        self.runtime_edges: dict[tuple[str, str], int] = {}  # edge -> count
+        self._held = threading.local()
+        self.violation: InvariantViolation | None = None
+
+    # ---- wiring -----------------------------------------------------------
+
+    def instrument(self, obj: Any, attr: str, name: str | None = None) -> str:
+        """Swap ``obj.attr`` for a recording proxy. The identity defaults to
+        dmlc-analyze's convention: ``type(obj).__module__.__qualname__.attr``."""
+        if name is None:
+            name = f"{type(obj).__module__}.{type(obj).__qualname__}.{attr}"
+        setattr(obj, attr, _LockProxy(getattr(obj, attr), name, self))
+        return name
+
+    @staticmethod
+    def from_analyze(package: str = "dmlc_tpu") -> "LockMonitor":
+        """Seed the hierarchy from dmlc-analyze's static lock graph."""
+        from tools.analyze.core import run_rules
+
+        analysis = run_rules(package)
+        return LockMonitor(static_edges=set(analysis.lock_edges))
+
+    # ---- recording --------------------------------------------------------
+
+    def _stack(self) -> list[str]:
+        if not hasattr(self._held, "stack"):
+            self._held.stack = []
+        return self._held.stack
+
+    def _acquired(self, name: str) -> None:
+        stack = self._stack()
+        if stack:
+            outer = stack[-1]
+            if outer != name:
+                edge = (outer, name)
+                self.runtime_edges[edge] = self.runtime_edges.get(edge, 0) + 1
+                self._check_edge(edge)
+        stack.append(name)
+
+    def _released(self, name: str) -> None:
+        stack = self._stack()
+        # Release order can interleave (rare, but legal); drop the most
+        # recent matching entry rather than insisting on LIFO.
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                return
+
+    # ---- checking ---------------------------------------------------------
+
+    def _check_edge(self, edge: tuple[str, str]) -> None:
+        outer, inner = edge
+        la, lb = self.levels.get(outer), self.levels.get(inner)
+        if la is not None and lb is not None and lb <= la:
+            self._violate(
+                f"level inversion: {outer} (level {la}) held while acquiring "
+                f"{inner} (level {lb})"
+            )
+        cycle = self._find_cycle(edge)
+        if cycle is not None:
+            self._violate("cyclic acquisition order: " + " -> ".join(cycle))
+
+    def _find_cycle(self, new_edge: tuple[str, str]) -> list[str] | None:
+        """Path from ``inner`` back to ``outer`` through static∪runtime edges
+        closes a cycle through the edge just observed."""
+        graph: dict[str, set[str]] = {}
+        for a, b in self.static_edges | set(self.runtime_edges):
+            graph.setdefault(a, set()).add(b)
+        outer, inner = new_edge
+        seen = set()
+        path = [inner]
+
+        def dfs(node: str) -> bool:
+            if node == outer:
+                return True
+            seen.add(node)
+            for nxt in sorted(graph.get(node, ())):
+                if nxt not in seen:
+                    path.append(nxt)
+                    if dfs(nxt):
+                        return True
+                    path.pop()
+            return False
+
+        if dfs(inner):
+            return [outer] + path + [outer] if path[-1] != outer else [outer] + path
+        return None
+
+    def _violate(self, message: str) -> None:
+        v = InvariantViolation("lock-hierarchy", message)
+        self.violation = v  # also surfaced via check() after the event
+        raise v
+
+    def check(self) -> None:
+        """Invariant hook: re-raise a violation that fired inside an event
+        body but was swallowed by intermediate exception handling."""
+        if self.violation is not None:
+            raise self.violation

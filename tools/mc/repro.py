@@ -1,0 +1,83 @@
+"""Committed counterexample format and its deterministic replay.
+
+A repro is a small JSON document pinning a (shrunk) schedule to a scenario:
+
+    {
+      "version": 1,
+      "scenario": "generate_ack_buggy",
+      "invariant": "exactly-once-prefix",
+      "message": "...what the violation looked like when found...",
+      "trace": ["submit:c0", "step", "poll_lost:c0", "poll:c0"],
+      "max_steps": 200
+    }
+
+Replay builds a fresh world from the scenario registry and drives it with
+the trace in loose mode (unmatched entries skip, gaps fill with defaults) —
+the same semantics the shrinker validated the trace under, so a committed
+repro keeps reproducing even if incidental event vocabulary around it
+shifts. ``tests/test_mc_repros.py`` replays every ``repros/*.json`` as
+pytest: a file whose scenario exists must either reproduce its invariant
+(regression present) or be named ``*.fixed.json`` (kept as evidence that
+the schedule is now clean).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+from tools.mc.core import MCFinding, RunResult, run_one
+from tools.mc.scenarios import get as get_scenario
+
+REPRO_DIR = Path(__file__).resolve().parent / "repros"
+VERSION = 1
+
+
+def to_doc(finding: MCFinding, *, max_steps: int = 200) -> dict[str, Any]:
+    return {
+        "version": VERSION,
+        "scenario": finding.scenario,
+        "invariant": finding.invariant,
+        "message": finding.message,
+        "trace": list(finding.trace),
+        "max_steps": max_steps,
+    }
+
+
+def save(doc: dict[str, Any], path: str | Path) -> Path:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+    return path
+
+
+def load(path: str | Path) -> dict[str, Any]:
+    doc = json.loads(Path(path).read_text())
+    if doc.get("version") != VERSION:
+        raise ValueError(f"{path}: unsupported repro version {doc.get('version')!r}")
+    for key in ("scenario", "invariant", "trace"):
+        if key not in doc:
+            raise ValueError(f"{path}: repro missing {key!r}")
+    return doc
+
+
+def replay(doc: dict[str, Any]) -> RunResult:
+    """Run the repro's schedule against its scenario, loose mode."""
+    scenario = get_scenario(doc["scenario"])
+    return run_one(
+        scenario,
+        doc["trace"],
+        max_steps=int(doc.get("max_steps", 200)),
+        strict=False,
+    )
+
+
+def reproduces(doc: dict[str, Any]) -> bool:
+    """True iff replaying the schedule violates the pinned invariant."""
+    run = replay(doc)
+    return run.violation is not None and run.violation.invariant == doc["invariant"]
+
+
+def committed() -> list[Path]:
+    return sorted(REPRO_DIR.glob("*.json"))

@@ -1,0 +1,45 @@
+"""Scenario registry: named worlds the explorer can build.
+
+Each scenario wires REAL cluster code (sdfs.py, generate/, membership.py,
+retrypolicy.py) onto the simulator fabrics and exposes its nondeterminism
+as events. Registration by name is what lets a committed repro JSON say
+``"scenario": "sdfs_put_crash_heal"`` and replay years later.
+"""
+
+from __future__ import annotations
+
+from tools.mc.core import Scenario
+
+_REGISTRY: dict[str, Scenario] = {}
+
+
+def register(scenario: Scenario) -> Scenario:
+    _REGISTRY[scenario.name] = scenario
+    return scenario
+
+
+def get(name: str) -> Scenario:
+    _load()
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown scenario {name!r}; have {sorted(_REGISTRY)}"
+        ) from None
+
+
+def names() -> list[str]:
+    _load()
+    return sorted(_REGISTRY)
+
+
+_loaded = False
+
+
+def _load() -> None:
+    """Import the scenario modules exactly once (each registers itself)."""
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    from tools.mc.scenarios import breaker, generate, membership, sdfs  # noqa: F401

@@ -1,0 +1,134 @@
+"""Breaker/admission monotonicity scenario: RetryPolicy state machine.
+
+One ``RetryPolicy`` (cluster/retrypolicy.py), one destination, and the
+full interleaving of what concurrent callers + a moving clock can throw at
+it: overload-class failures, successes, first-attempt admissions, retry
+admissions, and cooldown-sized clock advances. Every event is dependent on
+every other (one shared breaker), so there is no DPOR pruning here — the
+tree is the exact multiset of event orderings, small by budget.
+
+After every event the observed ``(breaker_state, open_count)`` pair is
+checked against the documented machine (docs/OVERLOAD.md):
+
+- ``breaker-open-count``  — ``open_count`` never decreases.
+- ``breaker-transition``  — observed state only moves along legal edges;
+                            in particular closed can never be SEEN jumping
+                            straight to half-open (half-open is only ever
+                            surfaced from an open breaker whose cooldown
+                            expired).
+- ``breaker-admission``   — ``allow()`` must refuse while the breaker is
+                            observably open (cooldown running).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from dmlc_tpu.cluster.rpc import RpcUnreachable
+from dmlc_tpu.cluster.retrypolicy import RetryPolicy
+from tools.mc.core import Event, InvariantViolation
+from tools.mc.scenarios import register
+
+DEST = "m0"
+
+
+class _World:
+    def __init__(self) -> None:
+        self._t = 0.0
+        self.rp = RetryPolicy(
+            clock=lambda: self._t,
+            breaker_threshold=2,
+            breaker_cooldown_s=5.0,
+            retry_rate_per_s=1.0,
+            retry_burst=2.0,
+        )
+        self.budgets = {"fail": 3, "ok": 1, "allow": 1, "allow_retry": 1,
+                        "advance": 2}
+        self.prev_state = self.rp.breaker_state(DEST)
+        self.prev_open = self.rp.open_count(DEST)
+
+    def enabled(self) -> list[Event]:
+        fires = {
+            "fail": self._fail, "ok": self._ok, "allow": self._allow,
+            "allow_retry": self._allow_retry, "advance": self._advance,
+        }
+        return [
+            Event(name, fires[name])  # empty footprint: all-dependent
+            for name in ("fail", "ok", "allow", "allow_retry", "advance")
+            if self.budgets[name] > 0
+        ]
+
+    def _fail(self) -> None:
+        self.budgets["fail"] -= 1
+        self.rp.record(DEST, RpcUnreachable("connection refused (mc)"))
+
+    def _ok(self) -> None:
+        self.budgets["ok"] -= 1
+        self.rp.record(DEST, None)
+
+    def _allow(self) -> None:
+        self.budgets["allow"] -= 1
+        state = self.rp.breaker_state(DEST)
+        got = self.rp.allow(DEST)
+        if state == "open" and got:
+            raise InvariantViolation(
+                "breaker-admission",
+                f"allow({DEST}) admitted a call while the breaker was open "
+                f"(cooldown still running)",
+            )
+
+    def _allow_retry(self) -> None:
+        self.budgets["allow_retry"] -= 1
+        state = self.rp.breaker_state(DEST)
+        got = self.rp.allow_retry(DEST)
+        if state == "open" and got:
+            raise InvariantViolation(
+                "breaker-admission",
+                f"allow_retry({DEST}) admitted a retry while the breaker "
+                f"was open",
+            )
+
+    def _advance(self) -> None:
+        self.budgets["advance"] -= 1
+        self._t += 3.0  # two advances clear the 5 s cooldown
+
+    # ---- invariants -------------------------------------------------------
+
+    #: observed-state edges the implementation is documented to produce
+    LEGAL = {
+        ("closed", "closed"), ("closed", "open"),
+        ("open", "open"), ("open", "half-open"), ("open", "closed"),
+        ("half-open", "half-open"), ("half-open", "open"),
+        ("half-open", "closed"),
+    }
+
+    def _check(self) -> None:
+        state = self.rp.breaker_state(DEST)
+        count = self.rp.open_count(DEST)
+        if count < self.prev_open:
+            raise InvariantViolation(
+                "breaker-open-count",
+                f"open_count({DEST}) fell {self.prev_open} -> {count}",
+            )
+        if (self.prev_state, state) not in self.LEGAL:
+            raise InvariantViolation(
+                "breaker-transition",
+                f"illegal observed transition {self.prev_state} -> {state}",
+            )
+        self.prev_state, self.prev_open = state, count
+
+    def invariants(self) -> list[tuple[str, Callable[[], None]]]:
+        return [("breaker", self._check)]
+
+    def close(self) -> None:
+        pass
+
+
+class _BreakerScenario:
+    name = "breaker"
+
+    def build(self) -> _World:
+        return _World()
+
+
+register(_BreakerScenario())

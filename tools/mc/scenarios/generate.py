@@ -1,0 +1,272 @@
+"""Generate ack scenario: exactly-once token delivery over at-least-once RPC.
+
+A real ``GenerateWorker`` (generate/worker.py) serves two clients through a
+toy single-threaded backend: ``step`` pushes the next planned token into
+every live ``GenStream`` (the decode loop's observable effect, minus the
+device), and each client runs the SAME consume protocol as
+``generate_stream`` — cumulative ack, dedup by seq. The fabric-level
+nondeterminism of the chunk-poll protocol is explicit:
+
+- ``poll:cX``       — a normal poll round-trip.
+- ``poll_dup:cX``   — the at-least-once fabric delivers the poll twice
+                      (``SimRpcNetwork.mc_hook`` -> ``MC_DUPLICATE``); the
+                      duplicate is only legal because ``job.generate_poll``
+                      is in ``IDEMPOTENT_VERBS`` (cluster/rpc.py) — the
+                      world refuses to build otherwise, keeping the
+                      registry honest.
+- ``poll_lost:cX``  — the server executes the poll but the reply is lost
+                      (``MC_DROP_REPLY``); the client sees RpcUnreachable
+                      and must NOT advance its ack.
+
+Invariants: every client's consumed tokens are always a prefix of its plan
+(``exactly-once-prefix`` — a dup or reorder breaks this immediately), and a
+client that believes it finished consumed the plan exactly
+(``exactly-once-complete`` — a lost token breaks this). The documented lock
+hierarchy (GenerateWorker._lock before GenStream._cv, seeded from
+dmlc-analyze's static lock graph) is asserted on every acquisition a
+schedule actually performs.
+
+``generate_ack_buggy`` is the seeded counterexample fixture (docs/
+MODELCHECK.md): its streams ship chunks and drop them IMMEDIATELY instead
+of retaining until the cumulative ack — the classic ack-before-retain bug.
+dmlc-mc finds the losing schedule, shrinks it, and the shrunk trace is
+committed under tools/mc/repros/ as a permanently replaying pytest.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from dmlc_tpu.cluster.rpc import (
+    IDEMPOTENT_VERBS,
+    MC_DELIVER,
+    MC_DROP_REPLY,
+    MC_DUPLICATE,
+    RpcUnreachable,
+    SimRpcNetwork,
+)
+from dmlc_tpu.generate.slots import GenStream
+from dmlc_tpu.generate.worker import GenerateWorker
+from tools.mc.core import Event, InvariantViolation
+from tools.mc.locks import LockMonitor
+from tools.mc.scenarios import register
+
+# GenerateWorker._lock is the outer lock, GenStream._cv the leaf — the
+# hierarchy dmlc-analyze's lock graph documents for the generate tier.
+LOCK_LEVELS = {
+    "dmlc_tpu.generate.worker.GenerateWorker._lock": 10,
+    "dmlc_tpu.generate.slots.GenStream._cv": 20,
+    "tools.mc.scenarios.generate._BuggyStream._cv": 20,
+}
+
+
+class _BuggyStream(GenStream):
+    """Ack-before-retain: hands chunks out once and forgets them, so a lost
+    or duplicated poll reply loses tokens forever."""
+
+    def chunks_after(self, ack: int) -> dict[str, Any]:
+        reply = super().chunks_after(ack)
+        with self._cv:
+            self._chunks = []  # the bug: retention dropped before the ack
+        return reply
+
+
+class _ToyBackend:
+    """Deterministic stand-in for GenerationBackend: ``submit`` returns a
+    real GenStream; the world's ``step`` event plays the decode loop."""
+
+    def __init__(self, stream_cls: type[GenStream], monitor: LockMonitor):
+        self.stream_cls = stream_cls
+        self.monitor = monitor
+        self.live: list[tuple[GenStream, list[int]]] = []  # (stream, remaining)
+
+    def submit(self, prompt: list[int], *, max_new_tokens: int,
+               temperature: float = 0.0, eos_id: int | None = None,
+               request_id: str = "") -> GenStream:
+        stream = self.stream_cls(request_id)
+        self.monitor.instrument(stream, "_cv")
+        plan = [int(prompt[0]) * 100 + i + 1 for i in range(int(max_new_tokens))]
+        self.live.append((stream, plan))
+        return stream
+
+    def step(self) -> None:
+        """One decode tick: every unfinished stream gains its next token;
+        a stream whose plan is exhausted is sealed."""
+        for stream, remaining in self.live:
+            if stream.done:
+                continue
+            if remaining:
+                stream.push([remaining.pop(0)])
+            if not remaining:
+                stream.finish()
+
+    def busy(self) -> bool:
+        return any(not s.done for s, _ in self.live)
+
+
+class _Client:
+    """The generate_stream consume protocol as explicit world state."""
+
+    def __init__(self, cid: str, prompt: int, tokens: int):
+        self.cid = cid
+        self.prompt = prompt
+        self.plan = [prompt * 100 + i + 1 for i in range(tokens)]
+        self.gen_id: str | None = None
+        self.acked = 0
+        self.consumed: list[int] = []
+        self.finished = False
+
+
+class _World:
+    def __init__(self, stream_cls: type[GenStream] = GenStream):
+        for verb in ("job.generate_poll",):
+            if verb not in IDEMPOTENT_VERBS:
+                raise RuntimeError(
+                    f"{verb} left IDEMPOTENT_VERBS; duplicate-delivery "
+                    "injection on it is no longer legal (docs/MODELCHECK.md)"
+                )
+        self.net = SimRpcNetwork()
+        self.monitor = LockMonitor(levels=LOCK_LEVELS)
+        self.backend = _ToyBackend(stream_cls, self.monitor)
+        self.worker = GenerateWorker(
+            {"toy": self.backend},  # type: ignore[dict-item]
+            session_ttl_s=1e9, clock=self.net.clock,
+        )
+        self.monitor.instrument(self.worker, "_lock")
+        self.net.serve("w", self.worker.methods())
+        self.clients = {
+            "c0": _Client("c0", prompt=1, tokens=2),
+            "c1": _Client("c1", prompt=2, tokens=1),
+        }
+        # poll-shaped event budgets per client: enough successful rounds to
+        # drain the stream even after the lossy variants fire
+        self.budgets = {
+            ("c0", "poll"): 3, ("c0", "poll_lost"): 1, ("c0", "poll_dup"): 1,
+            ("c1", "poll"): 2, ("c1", "poll_lost"): 1, ("c1", "poll_dup"): 1,
+        }
+        self.step_budget = 2
+        self._mc_action = MC_DELIVER
+
+    # ---- fabric hook ------------------------------------------------------
+
+    def _hook(self, source: str, addr: str, method: str) -> str:
+        action, self._mc_action = self._mc_action, MC_DELIVER
+        return action
+
+    def _call(self, client: _Client, action: str, payload: dict) -> dict:
+        self.net.mc_hook = self._hook
+        self._mc_action = action
+        try:
+            return self.net.client(client.cid).call(
+                "w", "job.generate_poll",
+                {"gen_id": client.gen_id, "ack": client.acked},
+            )
+        finally:
+            self.net.mc_hook = None
+            self._mc_action = MC_DELIVER
+
+    # ---- events -----------------------------------------------------------
+
+    def enabled(self) -> list[Event]:
+        out: list[Event] = []
+        for cid, c in sorted(self.clients.items()):
+            foot = frozenset({cid})
+            if c.gen_id is None:
+                out.append(Event(
+                    f"submit:{cid}", (lambda c=c: self._submit(c)), foot,
+                ))
+                continue
+            if c.finished:
+                continue
+            for kind in ("poll", "poll_dup", "poll_lost"):
+                if self.budgets.get((cid, kind), 0) > 0:
+                    out.append(Event(
+                        f"{kind}:{cid}",
+                        (lambda c=c, k=kind: self._poll(c, k)), foot,
+                    ))
+        if self.step_budget > 0 and self.backend.busy():
+            out.append(Event("step", self._step, frozenset({"c0", "c1"})))
+        return out
+
+    def _submit(self, c: _Client) -> None:
+        reply = self.net.client(c.cid).call(
+            "w", "job.generate",
+            {"model": "toy", "prompt": [c.prompt],
+             "max_new_tokens": len(c.plan)},
+        )
+        c.gen_id = reply["gen_id"]
+
+    def _step(self) -> None:
+        self.step_budget -= 1
+        self.backend.step()
+
+    def _poll(self, c: _Client, kind: str) -> None:
+        self.budgets[(c.cid, kind)] -= 1
+        action = {
+            "poll": MC_DELIVER,
+            "poll_dup": MC_DUPLICATE,
+            "poll_lost": MC_DROP_REPLY,
+        }[kind]
+        try:
+            r = self._call(c, action, {})
+        except RpcUnreachable:
+            return  # lost reply: the ack must not move
+        # generate_stream's dedup loop, verbatim semantics
+        for seq, toks in sorted(r.get("chunks", [])):
+            if seq <= c.acked:
+                continue
+            c.acked = seq
+            c.consumed.extend(int(t) for t in toks)
+        if r.get("done") and not r.get("chunks"):
+            c.finished = True
+
+    # ---- invariants -------------------------------------------------------
+
+    def _check_prefix(self) -> None:
+        for c in self.clients.values():
+            if c.consumed != c.plan[: len(c.consumed)]:
+                raise InvariantViolation(
+                    "exactly-once-prefix",
+                    f"{c.cid} consumed {c.consumed}, not a prefix of plan "
+                    f"{c.plan} (duplicate or reordered token)",
+                )
+
+    def _check_complete(self) -> None:
+        for c in self.clients.values():
+            if c.finished and c.consumed != c.plan:
+                raise InvariantViolation(
+                    "exactly-once-complete",
+                    f"{c.cid} finished with {c.consumed}, plan was {c.plan} "
+                    f"(token(s) lost)",
+                )
+
+    def invariants(self) -> list[tuple[str, Callable[[], None]]]:
+        return [
+            ("exactly-once-prefix", self._check_prefix),
+            ("exactly-once-complete", self._check_complete),
+            ("lock-hierarchy", self.monitor.check),
+        ]
+
+    def close(self) -> None:
+        self.net.mc_hook = None
+
+
+class _GenerateScenario:
+    name = "generate_ack"
+
+    def build(self) -> _World:
+        return _World(GenStream)
+
+
+class _GenerateBuggyScenario:
+    """The seeded ack-before-retain fixture bug (counterexample-replay
+    coverage): identical world, broken retention."""
+
+    name = "generate_ack_buggy"
+
+    def build(self) -> _World:
+        return _World(_BuggyStream)
+
+
+register(_GenerateScenario())
+register(_GenerateBuggyScenario())

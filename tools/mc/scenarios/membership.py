@@ -1,0 +1,125 @@
+"""Membership convergence scenario: 3-node SWIM gossip under the explorer.
+
+Three real ``MembershipNode``s on the datagram simulator (``SimNetwork``),
+sharing one ``SimClock``. The schedule owns everything the deployment
+environment normally decides: when each node's heartbeat ``step`` runs,
+which in-flight datagram lands next (``dlv:src->dst:i`` — per-index labels,
+since the FIFO holds duplicates of a (src, dst) pair), which datagram the
+network eats (``drop``, bounded), and when time advances (in half-heartbeat
+increments, bounded well past the failure timeout so false-failure windows
+open and close inside the horizon).
+
+The tree here is far too wide for exhaustive search at useful depth — this
+is the seeded random-walk CI leg (``python -m tools.mc ci`` walks it per
+``DMLC_CHAOS_SEED``). The terminal ``quiesce`` event closes every walk:
+once the chaos budgets are spent it runs bounded healthy rounds (all nodes
+step, all datagrams deliver, clock advances a heartbeat) and then asserts
+``membership-convergence``: every node's ACTIVE view names the same set of
+addresses. Anti-entropy + incarnation-stamped self-entries are supposed to
+make any divergence (including a false FAILED verdict from dropped acks)
+heal within a few rounds of a quiet network; a walk where they don't is a
+finding, not noise.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from dmlc_tpu.cluster.clock import SimClock
+from dmlc_tpu.cluster.membership import MembershipNode
+from dmlc_tpu.cluster.transport import SimNetwork
+from dmlc_tpu.utils.config import ClusterConfig
+from tools.mc.core import Event, InvariantViolation
+from tools.mc.scenarios import register
+
+ADDRS = ("a", "b", "c")
+
+
+class _World:
+    def __init__(self) -> None:
+        self.clock = SimClock()
+        self.net = SimNetwork()
+        cfg = ClusterConfig(heartbeat_interval_s=1.0, failure_timeout_s=3.0)
+        self.nodes: dict[str, MembershipNode] = {}
+        for addr in ADDRS:
+            node = MembershipNode(cfg, self.net.endpoint(addr), self.clock)
+            self.nodes[addr] = node
+            node.join("a")
+        self.net.deliver_all()  # joins land; chaos starts from a formed ring
+        self.tick_budget = {addr: 3 for addr in ADDRS}
+        self.advance_budget = 6
+        self.drop_budget = 2
+        self.done = False
+
+    def enabled(self) -> list[Event]:
+        if self.done:
+            return []
+        out: list[Event] = []
+        for addr in ADDRS:
+            if self.tick_budget[addr] > 0:
+                out.append(Event(
+                    f"tick:{addr}", (lambda a=addr: self._tick(a)),
+                    frozenset({a for a in ADDRS}),
+                ))
+        for i, (src, dst) in enumerate(self.net.pending()):
+            out.append(Event(
+                f"dlv:{src}->{dst}:{i}", (lambda i=i: self.net.deliver_one(i)),
+                frozenset({src, dst}),
+            ))
+            if i >= 3:
+                break  # bound the per-step fan-out; later frames get their turn
+        if self.drop_budget > 0 and self.net.pending():
+            out.append(Event("drop", self._drop, frozenset(ADDRS)))
+        if self.advance_budget > 0:
+            out.append(Event("advance", self._advance, frozenset(ADDRS)))
+        out.append(Event("quiesce", self._quiesce, frozenset(ADDRS)))
+        return out
+
+    def _tick(self, addr: str) -> None:
+        self.tick_budget[addr] -= 1
+        self.nodes[addr].step()
+
+    def _drop(self) -> None:
+        self.drop_budget -= 1
+        self.net.drop_one(0)
+
+    def _advance(self) -> None:
+        self.advance_budget -= 1
+        self.clock.advance(0.5)
+
+    def _views(self) -> dict[str, tuple[str, ...]]:
+        return {
+            addr: tuple(sorted({nid[0] for nid in node.active_ids()}))
+            for addr, node in self.nodes.items()
+        }
+
+    def _quiesce(self) -> None:
+        """Healthy rounds to fixpoint, then the convergence assertion."""
+        self.done = True
+        for _ in range(12):  # 4x failure timeout of quiet, lossless gossip
+            for node in self.nodes.values():
+                node.step()
+            self.net.deliver_all()
+            self.clock.advance(1.0)
+        views = self._views()
+        if len(set(views.values())) != 1:
+            raise InvariantViolation(
+                "membership-convergence",
+                f"ACTIVE views diverge after quiesce: {views}",
+            )
+
+    def invariants(self) -> list[tuple[str, Callable[[], None]]]:
+        return []  # the convergence check is the terminal event itself
+
+    def close(self) -> None:
+        pass
+
+
+class _MembershipScenario:
+    name = "membership_converge"
+
+    def build(self) -> _World:
+        return _World()
+
+
+register(_MembershipScenario())

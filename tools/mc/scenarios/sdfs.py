@@ -1,0 +1,303 @@
+"""SDFS put/crash/heal scenario: durability of an acked write.
+
+Two members, replication factor 2, fanout 1 (the placement loop is
+sequential, so the schedule — not a thread pool — decides all ordering).
+The root choice is WHERE the put crashes: ``boot`` runs the put clean;
+``boot@m1:k`` kills m1's process at its k-th DiskIo durability seam
+(CrashPointIo), exercising every torn state one placement copy can leave
+behind — including the window where m1's blob+sidecar are committed but
+the copy RPC never acked. After the put, the explorer interleaves at most
+ONE further fault (process crash of m1, or silent at-rest bit-rot on m0 —
+the budget mirrors the single failure rf=2 is specified to survive) with
+the recovery machinery: restart + announce of m1, m0's scrub pass, the
+leader's heal tick, and a client get.
+
+Invariants:
+
+- ``acked-blob-lost``     — an acked put must keep >=1 digest-clean
+                            on-disk copy at ALL times (disk survives a
+                            process crash; budget 1 < rf 2 makes this
+                            sound even before heal runs).
+- ``digest-divergence``   — a successful get must return the exact bytes
+                            that were put (sha256-compared).
+- ``directory-stale``     — the leader must not list a live member as
+                            replica of a blob that member neither holds
+                            committed nor has quarantined.
+- ``uncaught-exception``  — no legal schedule may crash client or leader
+                            code (a get with one faulted replica must fall
+                            back to the other, not raise).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import shutil
+import tempfile
+from pathlib import Path
+from typing import Callable
+
+from dmlc_tpu.cluster.diskio import hash_file
+from dmlc_tpu.cluster.faults import CrashPointIo, corrupt_stored
+from dmlc_tpu.cluster.rpc import SimRpcNetwork
+from dmlc_tpu.cluster.sdfs import MemberStore, SdfsClient, SdfsLeader, SdfsMember
+from tools.mc.core import Event, InvariantViolation
+from tools.mc.scenarios import register
+
+PAYLOAD = b"model-shard-0001 " * 64
+NAME = "ckpt"
+DIGEST = hashlib.sha256(PAYLOAD).hexdigest()
+MEMBERS = ("m0", "m1")
+
+_seam_cache: int | None = None
+
+
+def seam_count() -> int:
+    """How many DiskIo durability seams one placement copy to m1 crosses —
+    probed once with a recording CrashPointIo, sizes the boot@m1:k fan-out."""
+    global _seam_cache
+    if _seam_cache is None:
+        probe = CrashPointIo()
+        world = _World(crash_at=None, m1_io=probe)
+        try:
+            world._put()
+        finally:
+            world.close()
+        _seam_cache = len(probe.ops)
+    return _seam_cache
+
+
+class _World:
+    def __init__(self, crash_at: tuple[str, int] | None, m1_io=None):
+        self.tmp = Path(tempfile.mkdtemp(prefix="dmlc-mc-sdfs-"))
+        self.net = SimRpcNetwork()
+        self.alive: set[str] = set(MEMBERS)
+        self.stores: dict[str, MemberStore] = {}
+        self._crash_at = crash_at
+        self._countdown: int | None = None
+        for addr in MEMBERS:
+            io = m1_io if addr == "m1" else None
+            if crash_at is not None and crash_at[0] == addr:
+                io = CrashPointIo(self._crash_hook)
+            self._serve(addr, io)
+        self.leader = SdfsLeader(
+            self.net.client("L"), lambda: sorted(self.alive),
+            replication_factor=2, fanout=1,
+        )
+        self.net.serve("L", self.leader.methods())
+        # The client rides m0 (the harness convention): origin staging must
+        # live on a SERVED member, since replicate pulls chunks from it.
+        self.client = SdfsClient(
+            self.net.client("m0"), "L", self.stores["m0"], "m0"
+        )
+        # budgets — the knobs that bound the choice tree
+        self.put_done = False
+        self.put_acked = False
+        self.version: int | None = None
+        self.fault_budget = 0 if crash_at is not None else 1
+        self.can_restart = False
+        self.can_announce = False
+        self.scrub_budget = 1
+        self.heal_budget = 1
+        self.get_budget = 2
+
+    def _serve(self, addr: str, io=None) -> None:
+        store = MemberStore(self.tmp / addr, io=io)
+        self.stores[addr] = store
+        self.net.serve(addr, SdfsMember(store, self.net.client(addr)).methods())
+
+    def _crash_hook(self, op: str) -> bool:
+        if self._countdown is None:
+            return False
+        self._countdown -= 1
+        return self._countdown < 0
+
+    # ---- events -----------------------------------------------------------
+
+    def enabled(self) -> list[Event]:
+        out: list[Event] = []
+        if self.fault_budget > 0 and "m1" in self.alive:
+            out.append(Event("crash:m1", self._crash_m1, frozenset({"m1"})))
+        if self.fault_budget > 0 and self._committed("m0"):
+            out.append(Event("rot:m0", self._rot_m0, frozenset({"m0.disk"})))
+        if self.can_restart:
+            out.append(Event("restart:m1", self._restart_m1, frozenset({"m1"})))
+        if self.can_announce:
+            out.append(Event("announce:m1", self._announce_m1,
+                             frozenset({"m1", "dir"})))
+        if self.scrub_budget > 0:
+            out.append(Event("scrub:m0", self._scrub_m0,
+                             frozenset({"m0.disk", "dir"})))
+        if self.heal_budget > 0:
+            out.append(Event("heal", self._heal,
+                             frozenset({"m0", "m1", "dir"})))
+        if self.get_budget > 0 and self.put_acked:
+            out.append(Event("get", self._get,
+                             frozenset({"m0", "m1", "dir"})))
+        return out
+
+    def _put(self) -> None:
+        if self._crash_at is not None:
+            self._countdown = self._crash_at[1]
+        try:
+            reply = self.client.put_bytes(PAYLOAD, NAME)
+            self.put_acked = True
+            self.version = int(reply["version"])
+        finally:
+            self.put_done = True
+            self._countdown = None
+            if self._crash_at is not None:
+                io = self.stores[self._crash_at[0]].io
+                if getattr(io, "crashed", False):
+                    # The seam fired: that member's process died mid-copy.
+                    self.net.crash(self._crash_at[0])
+                    self.alive.discard(self._crash_at[0])
+                    self.can_restart = self._crash_at[0] == "m1"
+
+    def _crash_m1(self) -> None:
+        self.fault_budget -= 1
+        self.net.crash("m1")
+        self.alive.discard("m1")
+        self.can_restart = True
+
+    def _rot_m0(self) -> None:
+        self.fault_budget -= 1
+        assert self.version is not None
+        corrupt_stored(self.stores["m0"], NAME, self.version, seed=7)
+
+    def _restart_m1(self) -> None:
+        self.can_restart = False
+        self._serve("m1", io=None)  # fresh store on the same dir = restart
+        self.net.restart("m1")
+        self.alive.add("m1")
+        self.can_announce = True
+
+    def _announce_m1(self) -> None:
+        self.can_announce = False
+        reply = self.net.client("m1").call(
+            "L", "sdfs.announce",
+            {"member": "m1", "inventory": self.stores["m1"].inventory()},
+        )
+        for name in reply["dead"]:
+            self.stores["m1"].delete(name)
+        for name, v in reply["corrupt"]:
+            self.stores["m1"].quarantine(name, int(v))
+
+    def _scrub_m0(self) -> None:
+        self.scrub_budget -= 1
+        _, corrupt = self.stores["m0"].scrub_once(None)
+        for name, version in corrupt:
+            self.net.client("m0").call(
+                "L", "sdfs.report_corrupt",
+                {"name": name, "version": version, "member": "m0"},
+            )
+
+    def _heal(self) -> None:
+        self.heal_budget -= 1
+        self.leader.heal_once()
+
+    def _get(self) -> None:
+        self.get_budget -= 1
+        _, data = self.client.get_bytes(NAME)
+        got = hashlib.sha256(data).hexdigest()
+        if got != DIGEST:
+            raise InvariantViolation(
+                "digest-divergence",
+                f"get returned {len(data)} byte(s) with digest "
+                f"{got[:12]}.., put was {DIGEST[:12]}..",
+            )
+
+    # ---- invariants -------------------------------------------------------
+
+    def _committed(self, addr: str) -> bool:
+        if self.version is None:
+            return False
+        return self.stores[addr].blob_path(NAME, self.version).exists()
+
+    def _clean_copies(self) -> list[str]:
+        assert self.version is not None
+        out = []
+        for addr in MEMBERS:
+            path = self.stores[addr].blob_path(NAME, self.version)
+            if path.exists() and hash_file(path) == DIGEST:
+                out.append(addr)
+        return out
+
+    def _check_durability(self) -> None:
+        if not self.put_acked:
+            return
+        if not self._clean_copies():
+            raise InvariantViolation(
+                "acked-blob-lost",
+                f"acked put of {NAME!r} has no digest-clean on-disk copy "
+                f"left on any member",
+            )
+
+    def _quarantined_any(self, store: MemberStore) -> bool:
+        return any(store._quarantine_dir.iterdir())
+
+    def _check_directory(self) -> None:
+        if self.version is None:
+            return
+        for member in self.leader.state.replicas_of(NAME, self.version):
+            if member not in self.alive:
+                continue  # verdict pending: heal/announce will prune it
+            store = self.stores[member]
+            if store.blob_path(NAME, self.version).exists():
+                continue
+            if self._quarantined_any(store):
+                continue  # quarantine verdict is on its way to the leader
+            raise InvariantViolation(
+                "directory-stale",
+                f"leader lists live {member} as replica of "
+                f"{NAME}@{self.version} but it holds no copy",
+            )
+
+    def invariants(self) -> list[tuple[str, Callable[[], None]]]:
+        return [
+            ("acked-blob-lost", self._check_durability),
+            ("directory-stale", self._check_directory),
+        ]
+
+    def close(self) -> None:
+        shutil.rmtree(self.tmp, ignore_errors=True)
+
+
+class _RootChoiceWorld:
+    """The first decision picks the crash point (``boot`` = clean put,
+    ``boot@m1:k`` = m1 dies at seam k inside the put); the rest of the
+    schedule runs in the chosen world."""
+
+    def __init__(self) -> None:
+        self._world: _World | None = None
+
+    def enabled(self) -> list[Event]:
+        if self._world is not None:
+            return self._world.enabled()
+        full = frozenset({"m0", "m1", "dir"})
+        events = [Event("boot", lambda: self._boot(None), full)]
+        for k in range(seam_count()):
+            events.append(Event(
+                f"boot@m1:{k}", (lambda k=k: self._boot(("m1", k))), full,
+            ))
+        return events
+
+    def _boot(self, crash_at: tuple[str, int] | None) -> None:
+        self._world = _World(crash_at)
+        self._world._put()
+
+    def invariants(self) -> list[tuple[str, Callable[[], None]]]:
+        return [] if self._world is None else self._world.invariants()
+
+    def close(self) -> None:
+        if self._world is not None:
+            self._world.close()
+
+
+class _SdfsScenario:
+    name = "sdfs_put_crash_heal"
+
+    def build(self) -> _RootChoiceWorld:
+        return _RootChoiceWorld()
+
+
+register(_SdfsScenario())

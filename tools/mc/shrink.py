@@ -1,0 +1,81 @@
+"""Delta-debugging of violating schedules (ddmin over the choice trace).
+
+A raw witness from the explorer carries every incidental choice the DFS made
+on the way to the bug. The shrinker re-runs candidate sub-schedules in
+*loose* replay mode (``run_one(..., strict=False)``: prefix entries that are
+not enabled at replay time are skipped, gaps fill with the default chooser)
+and keeps a candidate iff it still reproduces the SAME invariant — so a
+shrunk-away event can never wedge the replay pointer, it just stops
+mattering. Classic ddmin (chunk removal at doubling granularity) followed by
+a one-at-a-time minimization pass; the result is 1-minimal: removing any
+single remaining label loses the violation.
+"""
+
+from __future__ import annotations
+
+from tools.mc.core import RunResult, Scenario, run_one
+
+
+def _reproduces(
+    scenario: Scenario, labels: list[str], invariant: str, max_steps: int
+) -> RunResult | None:
+    run = run_one(scenario, labels, max_steps=max_steps, strict=False)
+    if run.violation is not None and run.violation.invariant == invariant:
+        return run
+    return None
+
+
+def shrink(
+    scenario: Scenario,
+    labels: list[str],
+    invariant: str,
+    *,
+    max_steps: int = 200,
+    max_rounds: int = 64,
+) -> list[str]:
+    """Minimize ``labels`` while preserving a violation of ``invariant``.
+
+    Returns the shrunk label list — the labels the replay ACTUALLY picked on
+    the last reproducing run, not the candidate sub-list, so the committed
+    repro is exactly the schedule that fails."""
+    best = _reproduces(scenario, list(labels), invariant, max_steps)
+    if best is None:
+        # The witness itself must reproduce under loose replay; if not, the
+        # caller's trace is the best minimal form we can offer.
+        return list(labels)
+    current = best.labels
+
+    n = 2
+    rounds = 0
+    while len(current) >= 2 and rounds < max_rounds:
+        rounds += 1
+        chunk = max(1, len(current) // n)
+        shrunk = False
+        i = 0
+        while i < len(current):
+            candidate = current[:i] + current[i + chunk:]
+            run = _reproduces(scenario, candidate, invariant, max_steps)
+            if run is not None and len(run.labels) < len(current):
+                current = run.labels
+                n = max(2, n - 1)
+                shrunk = True
+                i = 0
+            else:
+                i += chunk
+        if not shrunk:
+            if chunk == 1:
+                break
+            n = min(len(current), n * 2)
+
+    # Final one-by-one pass: ddmin at chunk=1 can miss removals that only
+    # become possible after other chunks went away.
+    i = 0
+    while i < len(current):
+        candidate = current[:i] + current[i + 1:]
+        run = _reproduces(scenario, candidate, invariant, max_steps)
+        if run is not None and len(run.labels) < len(current):
+            current = run.labels
+            i = 0
+        else:
+            i += 1
+    return current

@@ -14,6 +14,14 @@ deliberately excluded so edits above a grandfathered finding do not churn
 the baseline, and the witness chain is excluded because it is derived.
 ``--update`` rewrites the baseline from the current run (review the diff —
 a growing baseline is a design smell, see docs/ANALYZE.md).
+
+``--mc-findings FILE`` (repeatable) folds a dmlc-mc results JSON
+(``python -m tools.mc ci --json FILE``, docs/MODELCHECK.md) into the same
+gate: each violation becomes the key ``("mc", scenario, invariant,
+message)`` — the schedule trace is excluded exactly like line numbers, so
+an equivalent violation found through a different interleaving is the
+same finding. A new invariant violation therefore fails CI like any new
+static finding would.
 """
 
 from __future__ import annotations
@@ -37,6 +45,18 @@ def current_findings(package: str, lint_paths: list[str]) -> list[Key]:
         keys.append(("lint", f.path, f.rule, f.message))
     for f in run_rules(package).findings:
         keys.append(("analyze", f.path, f.rule, f.message))
+    return keys
+
+
+def mc_findings(paths: list[str]) -> list[Key]:
+    """Violation keys from dmlc-mc results JSON files (tools/mc ci --json).
+    A missing file is a hard error — a CI step that silently gates on
+    nothing is worse than one that fails loudly."""
+    keys: list[Key] = []
+    for p in paths:
+        doc = json.loads(Path(p).read_text(encoding="utf-8"))
+        for f in doc.get("findings", []):
+            keys.append(("mc", f["scenario"], f["invariant"], f["message"]))
     return keys
 
 
@@ -79,6 +99,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="paths dmlc-lint runs over (default: its own)")
     parser.add_argument("--update", action="store_true",
                         help="rewrite the baseline from the current run")
+    parser.add_argument("--mc-findings", action="append", default=[],
+                        metavar="FILE",
+                        help="dmlc-mc results JSON (tools/mc ci --json) to "
+                             "fold into the gate; repeatable")
     args = parser.parse_args(argv)
 
     from tools.lint.core import DEFAULT_PATHS
@@ -86,6 +110,7 @@ def main(argv: list[str] | None = None) -> int:
     lint_paths = args.lint_paths or list(DEFAULT_PATHS)
     baseline_path = Path(args.baseline)
     keys = current_findings(args.package, lint_paths)
+    keys.extend(mc_findings(args.mc_findings))
 
     if args.update:
         write_baseline(baseline_path, keys)
@@ -102,7 +127,12 @@ def main(argv: list[str] | None = None) -> int:
 
     have, allowed = set(keys), set(baseline)
     new = sorted(have - allowed)
-    gone = sorted(allowed - have)
+    # Without mc results to compare, a grandfathered mc entry cannot be
+    # observed firing — never report it as gone from a static-only run.
+    observable = allowed if args.mc_findings else {
+        k for k in allowed if k[0] != "mc"
+    }
+    gone = sorted(observable - have)
     for t, p, r, m in gone:
         print(f"dmlc-ratchet: WARNING: baseline entry no longer fires "
               f"({t}: {p}: {r} {m}) — shrink it: "
