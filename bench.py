@@ -483,7 +483,7 @@ def merge_detail(new: dict, old: dict) -> dict:
     # truncated run (e.g. train that only reached vit_b16_train) keeps the
     # previous lm_flash_train instead of deleting it; staleness is stamped
     # INSIDE each kept entry, never at section level where consumers iterate.
-    for key in ("flash", "train", "lm_decode"):
+    for key in ("flash", "train", "lm_decode", "sharded"):
         new_sec = {k: v for k, v in (new.get(key) or {}).items() if isinstance(v, dict)}
         old_sec = {k: v for k, v in (old.get(key) or {}).items() if isinstance(v, dict)}
         merged = {k: dict(v, stale=True) for k, v in old_sec.items()}
@@ -1113,6 +1113,139 @@ def bench_lm_decode(
     return {entry_name: entry}
 
 
+def _sharded_probe(
+    lm_model: str = "lm_wide",
+    clip_model: str = "clip_vit_l14",
+    prompt_len: int = 32,
+    lm_batch: int = 16,
+    clip_batch: int = 4,
+    seconds: float = 2.0,
+    gang_width: int = 0,
+) -> dict:
+    """Measurement body of the ``sharded`` leg, runnable in-process (>= 2
+    real chips) or in a forced-multi-device CPU subprocess (bench_sharded
+    picks). Returns the dict-of-entries section. Every entry records
+    ``platform`` and ``virtual_devices`` so the artifact says honestly
+    whether the gang ran on silicon or on XLA's host-platform split — a
+    virtual 2-chip 'speedup' on a 1-core host measures overhead, not gain
+    (the acceptance record in docs/SHARDING.md)."""
+    import jax
+
+    from dmlc_tpu.models.registry import get_model
+    from dmlc_tpu.parallel import sharding as sl
+    from dmlc_tpu.parallel.mesh import make_mesh
+
+    n = jax.device_count()
+    platform = jax.devices()[0].platform
+    virtual = "host_platform_device_count" in os.environ.get("XLA_FLAGS", "")
+    common = {"platform": platform, "devices": n, "virtual_devices": virtual}
+
+    def rate(prog, batch) -> float:
+        prog.run(batch)  # warm/compile outside the timed window
+        reps, t0 = 0, time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            prog.run(batch)
+            reps += 1
+        return reps * batch.shape[0] / (time.perf_counter() - t0)
+
+    out: dict = {}
+
+    # --- lm gang predict: the over-HBM model serving across a chip gang ---
+    spec = get_model(lm_model)
+    width = gang_width or min(4, n)
+    axes = sl.plan_axes(width, num_heads=spec.num_heads)
+    gang = sl.ShardedProgram(lm_model, make_mesh(axes, devices=jax.devices()[:width]))
+    toks = sl.encode_prompts(
+        [f"p{i}" for i in range(lm_batch)], prompt_len, spec.num_outputs
+    )
+    ref = sl.ShardedProgram(
+        lm_model, make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    )
+    identical = bool((ref.run(toks) == gang.run(toks)).all())
+    out[f"{lm_model}_gang"] = dict(
+        common,
+        model=lm_model,
+        gang=width,
+        axes=dict(axes),
+        batch=lm_batch,
+        prompt=prompt_len,
+        predictions_per_sec=round(rate(gang, toks), 1),
+        token_identical_vs_ref=identical,
+        per_chip_resident_bytes=int(sl.sharded_bytes_per_chip(lm_model, gang.mesh)),
+        replicated_bytes=int(spec.param_bytes()),
+    )
+
+    # --- CLIP tensor-parallel: 1-chip vs 2-chip img/s on the same rules ---
+    rng = np.random.default_rng(0)
+    size = get_model(clip_model).input_size
+    imgs = rng.integers(0, 255, (clip_batch, size, size, 3), dtype=np.uint8)
+    rates: dict[int, float] = {}
+    for w in (1, 2):
+        if w > n:
+            continue
+        tp_axes = sl.plan_axes(w, num_heads=get_model(clip_model).num_heads)
+        prog = sl.ShardedProgram(
+            clip_model, make_mesh(tp_axes, devices=jax.devices()[:w])
+        )
+        rates[w] = rate(prog, imgs)
+    entry = dict(common, model=clip_model, batch=clip_batch)
+    entry["img_s_1chip"] = round(rates[1], 2) if 1 in rates else None
+    entry["img_s_2chip"] = round(rates[2], 2) if 2 in rates else None
+    if 1 in rates and 2 in rates and rates[1] > 0:
+        entry["speedup_2chip"] = round(rates[2] / rates[1], 3)
+    out["clip_tp"] = entry
+    return out
+
+
+def bench_sharded(deadline: float | None = None, **probe_kwargs) -> dict:
+    """Gang-sharded serving leg (docs/SHARDING.md): the partition-rule
+    engine's compiled programs measured at gang widths — lm_wide predict
+    across a gang (with token-identity vs the mesh-of-1 reference asserted
+    in-band) and CLIP tensor-parallel 1-chip vs 2-chip img/s. With fewer
+    than 2 local devices the probe runs in a CPU subprocess under
+    ``--xla_force_host_platform_device_count=8``; entries carry
+    ``virtual_devices: true`` so nobody mistakes the virtual split for a
+    silicon speedup."""
+    import jax
+
+    if jax.device_count() >= 2:
+        return _sharded_probe(**probe_kwargs)
+    import subprocess as sp
+
+    args_json = json.dumps(probe_kwargs)
+    script = (
+        "import json, sys\n"
+        "from bench import _sharded_probe\n"
+        "print(json.dumps(_sharded_probe(**json.loads(sys.argv[1]))))\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    left = _time_left(deadline)
+    r = sp.run(
+        [sys.executable, "-c", script, args_json],
+        capture_output=True, text=True,
+        timeout=max(30.0, left if left != float("inf") else 600.0),
+        env=env, cwd=str(Path(__file__).parent),
+    )
+    if r.returncode != 0 or not r.stdout.strip():
+        raise RuntimeError(f"subprocess rc={r.returncode}: {r.stderr.strip()[-500:]}")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def annotate_sharded_entries(section: dict, old_section: dict) -> dict:
+    """sharded-leg guard, same contract as flash/train/lm_decode: rates
+    track their best-known MAXIMUM and a >2x-low window is flagged (merge
+    keeps the previous healthy entry); a model/width/batch/platform change
+    resets history — a first virtual-device capture must not be judged
+    against silicon numbers or vice versa."""
+    return _annotate_rate_entries(
+        section, old_section,
+        ("predictions_per_sec", "img_s_1chip", "img_s_2chip"), max, 2,
+        config_keys=("model", "gang", "batch", "prompt", "devices", "platform"),
+    )
+
+
 RAW_SIZE = 256  # corpus native size; the device-resize staging size
 
 # Measured bounds behind the MFU numbers (VERDICT r4 item: ViT-class models
@@ -1401,6 +1534,7 @@ def main() -> None:
         "curve_point": 30.0,
         "train": 100.0,
         "lm_decode": 90.0,
+        "sharded": 300.0,  # two CLIP compiles (1- and 2-chip meshes) dominate
     }
 
     # Per-model batch tuning, backed by the measured batch curves that land
@@ -1697,6 +1831,32 @@ def main() -> None:
             print(f"[bench-lm-decode] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
         devlegs.end("lm_decode")
 
+    # Gang-sharded serving (parallel/sharding.py, docs/SHARDING.md): the
+    # rule engine's compiled programs at gang widths, budget-gated.
+    sharded = {}
+    if not over_budget("sharded"):
+        devlegs.begin("sharded")
+        try:
+            sharded = annotate_sharded_entries(
+                bench_sharded(deadline=time.monotonic() + CAPS["sharded"]),
+                prev_detail.get("sharded") or {},
+            )
+            for key, r in sharded.items():
+                print(
+                    f"[bench-sharded] {key}: model={r.get('model')} "
+                    f"platform={r.get('platform')}"
+                    f"{' (virtual devices)' if r.get('virtual_devices') else ''} "
+                    f"gang={r.get('gang')} "
+                    f"pred/s={r.get('predictions_per_sec')} "
+                    f"img/s 1chip={r.get('img_s_1chip')} "
+                    f"2chip={r.get('img_s_2chip')} "
+                    f"token_identical={r.get('token_identical_vs_ref')}",
+                    file=sys.stderr,
+                )
+        except Exception as e:
+            print(f"[bench-sharded] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+        devlegs.end("sharded")
+
     # Extra models: measured numbers for the remaining reference configs,
     # strictly after every primary section has had its shot at the budget.
     for model in [m.strip() for m in args.extra_models.split(",") if m.strip()]:
@@ -1736,6 +1896,7 @@ def main() -> None:
         "flash": flash,
         "train": train,
         "lm_decode": lm_decode,
+        "sharded": sharded,
         "device": devlegs.section(results),
         "roofline_notes": ROOFLINE_NOTES,
     }

@@ -50,6 +50,7 @@ from dmlc_tpu.scheduler.worker import (
     DynamicBatcher,
     EngineBackend,
     ExportedBackend,
+    LmBackend,
     ModelLoader,
     PredictWorker,
 )
@@ -73,13 +74,28 @@ def member_rpc_addr(gossip_addr: str, port_offset: int) -> str:
 def _backend_resident(backend) -> int | None:
     """Resident device bytes of a predict backend's engine — None until the
     lazy engine builds (or for backends without the capability, e.g. the
-    hermetic test fakes)."""
-    engine = getattr(backend, "_engine", None)
-    fn = getattr(engine, "resident_bytes", None)
+    hermetic test fakes). Backends that know their own footprint (LmBackend:
+    PER-CHIP sharded bytes, not the replicated total) answer directly."""
+    fn = getattr(backend, "resident_bytes", None)
+    if fn is None:
+        engine = getattr(backend, "_engine", None)
+        fn = getattr(engine, "resident_bytes", None)
     try:
         return int(fn()) if fn is not None else None
     except Exception:  # noqa: BLE001 - gauge read must never raise
         return None
+
+
+def _model_kind(name: str) -> str:
+    """Registry kind for a job model ("image"/"lm"); unknown names fall back
+    to "image" so a misconfigured job fails in the backend, with a real
+    error, rather than here at wiring time."""
+    try:
+        from dmlc_tpu.models.registry import get_model
+
+        return get_model(name).kind
+    except Exception:  # noqa: BLE001 - wiring must not die on a bad name
+        return "image"
 
 
 def _gen_resident(backend) -> int | None:
@@ -256,24 +272,33 @@ class ClusterNode:
             gate=self.transfer_gate,
         )
         if backends is None:
-            if config.serve_from_executable:
-                # sdfs is wired in below once the client exists (the member
-                # server needs the backends first); the backend is lazy, so
-                # nothing touches sdfs until warmup/first shard.
-                # No batch size here: the serving batch is the published
-                # artifact's, fixed at export time.
-                backends = {
-                    name: ExportedBackend(name, config.data_dir, sdfs=None)
-                    for name in config.job_models
-                }
-            else:
-                backends = {
-                    name: EngineBackend(
+            backends = {}
+            for name in config.job_models:
+                if _model_kind(name) == "lm":
+                    # kind="lm" jobs serve through the gang-aware sharded
+                    # path regardless of the image-serving deployment shape:
+                    # the compiled program IS the artifact (docs/SHARDING.md).
+                    backends[name] = LmBackend(
+                        name,
+                        gang_devices=config.lm_gang_devices,
+                        prompt_len=config.lm_prompt_len,
+                        hbm_budget_bytes=config.lm_hbm_budget_bytes,
+                        device_work=self.devicemon.device_work,
+                    )
+                elif config.serve_from_executable:
+                    # sdfs is wired in below once the client exists (the
+                    # member server needs the backends first); the backend is
+                    # lazy, so nothing touches sdfs until warmup/first shard.
+                    # No batch size here: the serving batch is the published
+                    # artifact's, fixed at export time.
+                    backends[name] = ExportedBackend(
+                        name, config.data_dir, sdfs=None
+                    )
+                else:
+                    backends[name] = EngineBackend(
                         name, config.data_dir, batch_size=config.batch_size,
                         device_work=self.devicemon.device_work,
                     )
-                    for name in config.job_models
-                }
         self.worker = PredictWorker(backends, gate=self.predict_gate)
         # Per-model device accounting: resident_bytes_<model> (None until
         # the lazy engine builds) + mfu_<model> gauges. Registered against
@@ -471,6 +496,15 @@ class ClusterNode:
             return []
         return [(synset, i) for i, (synset, _) in enumerate(load_synset_words(path))]
 
+    def _job_workload(self, name: str, workload: list[tuple[str, int]]):
+        """Per-job query list. Image jobs share the synset workload; lm jobs
+        get synthetic PROMPT IDs with truth -1 — the leader never builds the
+        model, so token-identity truth lives in the bench/tests, which run
+        the single-process reference themselves (docs/SHARDING.md)."""
+        if _model_kind(name) != "lm":
+            return list(workload)
+        return [(f"p{i}", -1) for i in range(len(workload) or 64)]
+
     def _start_leader_services(self) -> None:
         workload = self._load_workload()
         self.sdfs_leader = SdfsLeader(
@@ -512,7 +546,10 @@ class ClusterNode:
         self.scheduler = JobScheduler(
             self.rpc,
             self.active_member_addrs,
-            jobs={name: list(workload) for name in self.config.job_models},
+            jobs={
+                name: self._job_workload(name, workload)
+                for name in self.config.job_models
+            },
             shard_size=self.config.dispatch_shard_size,
             shard_timeout_s=self.config.predict_deadline_s,
             member_weight=self._member_weight,
@@ -527,6 +564,15 @@ class ClusterNode:
             profiler=self.profiler,
             advisor=self.advisor,
         )
+        # Gang placement read-out: the advisor-planned gang width per job
+        # (0 = solo/replicated serving) — the leader-side complement of the
+        # per-member resident_bytes_<model> gauges, so "which jobs are
+        # gangs, how wide" is scrapeable without reading flight notes.
+        for job_name in self.config.job_models:
+            self.registry.gauge(
+                f"gang_world_{job_name}",
+                lambda n=job_name: self.scheduler.jobs[n].gang_world,
+            )
         # SLO burn-rate evaluation (scheduler/placement.SloEvaluator): runs
         # on the scrape cadence while leading; a fast-burn edge asks the
         # scheduler for a replan — the closed loop the objectives exist for.

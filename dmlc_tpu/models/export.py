@@ -31,11 +31,22 @@ from dmlc_tpu.models.registry import get_model
 from dmlc_tpu.ops import preprocess as pp
 
 MAGIC = b"DMLCHLO1"
+# Gang-sharded executables (docs/SHARDING.md): same artifact discipline, but
+# the program was traced under a rule-derived mesh, so the blob additionally
+# records the mesh axes it must be re-instantiated on.
+SHARDED_MAGIC = b"DMLCHLO2"
 
 
 def sdfs_executable_name(model_name: str) -> str:
     """Canonical SDFS name for a model's serving executable."""
     return f"executables/{model_name}"
+
+
+def sdfs_sharded_executable_name(model_name: str, n_devices: int) -> str:
+    """Canonical SDFS name for a gang's sharded executable: one artifact per
+    (model, gang width) — the same model ganged at a different width is a
+    different compiled program."""
+    return f"executables/{model_name}@{int(n_devices)}"
 
 
 def build_serving_forward(model_name: str, dtype=jnp.bfloat16):
@@ -70,6 +81,72 @@ def export_serving(model_name: str, batch_size: int = 256, dtype=jnp.bfloat16) -
     exported = jax_export.export(jax.jit(forward))(template, u8)
     name_b = model_name.encode()
     return MAGIC + len(name_b).to_bytes(2, "big") + name_b + bytes(exported.serialize())
+
+
+def export_sharded_serving(
+    model_name: str,
+    mesh,
+    *,
+    batch_size: int = 8,
+    seq_len: int = 16,
+    dtype=jnp.float32,
+) -> bytes:
+    """Export the partition-rule-sharded serving program at a mesh shape —
+    the gang's executable (docs/SHARDING.md). The jit carries the rule
+    engine's in/out shardings, so the artifact bakes in the collective
+    layout; the blob records the mesh axes it was traced under, because a
+    deserialized sharded program only runs on a mesh of the same shape."""
+    import json
+
+    from dmlc_tpu.parallel.sharding import ShardedProgram
+
+    spec = get_model(model_name)
+    prog = ShardedProgram(model_name, mesh, dtype=dtype)
+    forward = prog._build_forward()
+    template = jax.tree_util.tree_map(
+        lambda leaf: jax.ShapeDtypeStruct(jnp.shape(leaf), leaf.dtype),
+        prog.variables,
+    )
+    if spec.kind == "lm":
+        data = jax.ShapeDtypeStruct((batch_size, seq_len), jnp.int32)
+    else:
+        data = jax.ShapeDtypeStruct(
+            (batch_size, spec.input_size, spec.input_size, 3), jnp.uint8
+        )
+    exported = jax_export.export(forward)(template, data)
+    axes_b = json.dumps(
+        dict(zip(mesh.axis_names, (int(s) for s in mesh.devices.shape)))
+    ).encode()
+    name_b = model_name.encode()
+    return (
+        SHARDED_MAGIC
+        + len(name_b).to_bytes(2, "big")
+        + name_b
+        + len(axes_b).to_bytes(2, "big")
+        + axes_b
+        + bytes(exported.serialize())
+    )
+
+
+def load_sharded_serving(data: bytes, expect_model: str | None = None):
+    """-> (model_name, mesh_axes, exported) for a gang executable blob. The
+    caller re-creates a mesh of exactly ``mesh_axes`` (parallel.mesh.
+    make_mesh) before ``exported.call`` — jax refuses an artifact whose
+    device count disagrees with the runtime mesh, by design."""
+    import json
+
+    if data[: len(SHARDED_MAGIC)] != SHARDED_MAGIC:
+        raise ValueError("not a dmlc sharded executable blob (bad magic)")
+    off = len(SHARDED_MAGIC)
+    n = int.from_bytes(data[off : off + 2], "big")
+    model_name = data[off + 2 : off + 2 + n].decode()
+    off = off + 2 + n
+    m = int.from_bytes(data[off : off + 2], "big")
+    mesh_axes = {k: int(v) for k, v in json.loads(data[off + 2 : off + 2 + m]).items()}
+    if expect_model is not None and model_name != expect_model:
+        raise ValueError(f"executable is for {model_name!r}, expected {expect_model!r}")
+    exported = jax_export.deserialize(bytearray(data[off + 2 + m :]))
+    return model_name, mesh_axes, exported
 
 
 def load_serving(data: bytes, expect_model: str | None = None):

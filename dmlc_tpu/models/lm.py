@@ -21,6 +21,32 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def lm_wide(dtype=jnp.float32):
+    """The gang-serving proof model (ISSUE 17): head_dim 128 (the MXU lane
+    width the bench geometry calls for), sized so its resident weights
+    overflow the single-chip HBM budget in the test harness — it only serves
+    sharded, across a chip gang the PlacementAdvisor picks from HBM headroom.
+    Geometry: 4 heads x 128 head_dim = 512 hidden, 2 layers, vocab 2048
+    (~6M params: seed-init stays sub-second on the CPU test mesh)."""
+    from dmlc_tpu.parallel.sp_transformer import SPTransformerLM
+
+    return SPTransformerLM(
+        vocab=LM_WIDE_VOCAB,
+        num_layers=2,
+        num_heads=4,
+        hidden=512,
+        mlp_dim=1024,
+        max_len=LM_WIDE_MAX_LEN,
+        schedule="dense",
+        dtype=dtype,
+    )
+
+
+LM_WIDE_VOCAB = 2048
+LM_WIDE_MAX_LEN = 128
+LM_WIDE_NUM_HEADS = 4
+
+
 def lm_small(dtype=jnp.float32):
     """A seed-initialized small causal LM (dense attention schedule: the
     single-device regime; the generation engine supplies its own paged

@@ -20,9 +20,21 @@ import jax.numpy as jnp
 
 from dmlc_tpu.models.alexnet import alexnet
 from dmlc_tpu.models.clip import clip_vit_b32, clip_vit_l14
-from dmlc_tpu.models.lm import LM_SMALL_MAX_LEN, LM_SMALL_VOCAB, lm_small
+from dmlc_tpu.models.lm import (
+    LM_SMALL_MAX_LEN,
+    LM_SMALL_VOCAB,
+    LM_WIDE_MAX_LEN,
+    LM_WIDE_NUM_HEADS,
+    LM_WIDE_VOCAB,
+    lm_small,
+    lm_wide,
+)
 from dmlc_tpu.models.resnet import resnet18, resnet34, resnet50
 from dmlc_tpu.models.vit import vit_b16, vit_l14
+from dmlc_tpu.parallel.sharding import (
+    REPLICATED_PARTITION_RULES,
+    TRANSFORMER_PARTITION_RULES,
+)
 
 
 @dataclass(frozen=True)
@@ -33,6 +45,11 @@ class ModelSpec:
     num_outputs: int                   # classes / embedding dim; vocab for "lm"
     classifier: bool = True            # False => embedding model (no top-1/accuracy)
     kind: str = "image"                # "image" | "lm" (autoregressive decode)
+    # Ordered (regex, PartitionSpec) table consumed by parallel/sharding.py:
+    # declared ONCE here, compiled into sharded programs at any mesh shape.
+    # None => fully replicated (the CNN families). num_heads bounds tp.
+    partition_rules: tuple[tuple[str, Any], ...] | None = None
+    num_heads: int | None = None
 
     def module(self, dtype=jnp.bfloat16):
         if self.kind == "lm":
@@ -195,6 +212,9 @@ _FLOPS_PER_ITEM: dict[str, Callable[[], float]] = {
     "lm_small": lambda: _lm_decode_flops(
         LM_SMALL_VOCAB, 2, 128, 256, LM_SMALL_MAX_LEN
     ),
+    "lm_wide": lambda: _lm_decode_flops(
+        LM_WIDE_VOCAB, 2, 512, 1024, LM_WIDE_MAX_LEN
+    ),
 }
 
 
@@ -216,14 +236,22 @@ def list_models() -> list[str]:
 
 
 for _spec in [
-    ModelSpec("resnet18", resnet18, 224, 1000),
-    ModelSpec("resnet34", resnet34, 224, 1000),
-    ModelSpec("resnet50", resnet50, 224, 1000),
-    ModelSpec("alexnet", alexnet, 224, 1000),
-    ModelSpec("vit_b16", vit_b16, 224, 1000),
-    ModelSpec("vit_l14", vit_l14, 224, 1000),
-    ModelSpec("clip_vit_l14", clip_vit_l14, 224, 768, classifier=False),
-    ModelSpec("clip_vit_b32", clip_vit_b32, 224, 512, classifier=False),
+    ModelSpec("resnet18", resnet18, 224, 1000,
+              partition_rules=REPLICATED_PARTITION_RULES),
+    ModelSpec("resnet34", resnet34, 224, 1000,
+              partition_rules=REPLICATED_PARTITION_RULES),
+    ModelSpec("resnet50", resnet50, 224, 1000,
+              partition_rules=REPLICATED_PARTITION_RULES),
+    ModelSpec("alexnet", alexnet, 224, 1000,
+              partition_rules=REPLICATED_PARTITION_RULES),
+    ModelSpec("vit_b16", vit_b16, 224, 1000,
+              partition_rules=TRANSFORMER_PARTITION_RULES, num_heads=12),
+    ModelSpec("vit_l14", vit_l14, 224, 1000,
+              partition_rules=TRANSFORMER_PARTITION_RULES, num_heads=16),
+    ModelSpec("clip_vit_l14", clip_vit_l14, 224, 768, classifier=False,
+              partition_rules=TRANSFORMER_PARTITION_RULES, num_heads=16),
+    ModelSpec("clip_vit_b32", clip_vit_b32, 224, 512, classifier=False,
+              partition_rules=TRANSFORMER_PARTITION_RULES, num_heads=12),
     # Servable causal LM for the generation engine (dmlc_tpu/generate/):
     # init from seed, weights hot-swapped via the SDFS models/<name> blob
     # path like every other entry. input_size carries max_len, num_outputs
@@ -231,6 +259,14 @@ for _spec in [
     ModelSpec(
         "lm_small", lm_small, LM_SMALL_MAX_LEN, LM_SMALL_VOCAB,
         classifier=False, kind="lm",
+        partition_rules=TRANSFORMER_PARTITION_RULES, num_heads=2,
+    ),
+    # Gang-serving proof model (ISSUE 17): over the single-chip HBM budget
+    # in the test harness, serves only as a >=2 chip gang (docs/SHARDING.md).
+    ModelSpec(
+        "lm_wide", lm_wide, LM_WIDE_MAX_LEN, LM_WIDE_VOCAB,
+        classifier=False, kind="lm",
+        partition_rules=TRANSFORMER_PARTITION_RULES, num_heads=LM_WIDE_NUM_HEADS,
     ),
 ]:
     register(_spec)

@@ -6,7 +6,6 @@ from dmlc_tpu.parallel.mesh import (
     replicated,
     shard_params,
 )
-from dmlc_tpu.parallel.inference import BatchResult, InferenceEngine
 from dmlc_tpu.parallel.ring_attention import dense_attention, ring_attention
 from dmlc_tpu.parallel.sp_transformer import (
     SPSelfAttention,
@@ -21,3 +20,16 @@ from dmlc_tpu.parallel.train import (
     make_train_step,
     state_shardings,
 )
+
+
+def __getattr__(name: str):
+    # Lazy (PEP 562): inference imports dmlc_tpu.models, and models.registry
+    # imports parallel.sharding's rule tables — an eager import here would
+    # close that loop into a circular-import crash whichever side loads
+    # first. Deferring the ONE models-dependent module breaks the cycle
+    # without pushing lazy imports into every registry call site.
+    if name in ("BatchResult", "InferenceEngine"):
+        from dmlc_tpu.parallel import inference
+
+        return getattr(inference, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -98,6 +98,12 @@ class Job:
     # retry would busy-loop forever; past a small cap the job is stopped
     # with the error surfaced in the report instead.
     gang_consec_failures: int = 0
+    # Advisor-planned gang width (docs/SHARDING.md): when >= 2 the job's
+    # assigned members are ONE placement unit — a chip gang in sorted-member
+    # rank order — and dispatch rides the collective gang path instead of
+    # the per-member pool. 0 = solo dispatch. Leader-plan-local (a new
+    # leader replans from its own advisor; never replicated).
+    gang_world: int = 0
     last_error: str = ""
     # Wall-clock throughput window (leader-local, this term only): first
     # dispatch and latest completion stamps from the scheduler's timer.
@@ -147,6 +153,7 @@ class Job:
             "assigned": list(self.assigned),
             "gang_shards": self.gang_shards,
             "gang_staged_ranks": self.gang_staged_ranks,
+            "gang_world": self.gang_world,
             "last_error": self.last_error,
             "query_latency": self.query_stats.summary(),
             "shard_latency": self.shard_stats.summary(),
@@ -419,12 +426,14 @@ class JobScheduler:
                 if name not in running:
                     job.assigned = []
                     job.dispatch_pool = []
+                    job.gang_world = 0
             if not running:
                 return
             if group:
                 for name in running:
                     self.jobs[name].assigned = sorted(group)
                     self.jobs[name].dispatch_pool = []
+                    self.jobs[name].gang_world = 0
                 return
             if self.advisor is not None and self._assign_from_plan(
                 running, members, weights, trigger
@@ -432,6 +441,7 @@ class JobScheduler:
                 return
             for i, name in enumerate(running):
                 job = self.jobs[name]
+                job.gang_world = 0
                 job.assigned = [
                     m for k, m in enumerate(members) if k % len(running) == i
                 ]
@@ -468,9 +478,16 @@ class JobScheduler:
         for name in running:
             job = self.jobs[name]
             assigned = sorted(plan.assignment[name])
-            if assigned != job.assigned:
+            width = int(plan.gangs.get(name, 0))
+            if assigned != job.assigned or width != job.gang_world:
                 changed = True
             job.assigned = assigned
+            job.gang_world = width
+            if width:
+                # Gang jobs have no dispatch pool: the whole unit takes
+                # every shard collectively (rank = sorted-member index).
+                job.dispatch_pool = []
+                continue
             wmap = plan.weights.get(name) or {}
             w = {m: max(1, int(wmap.get(m, weights.get(m, 1)))) for m in assigned}
             pool: list[str] = []
@@ -478,10 +495,15 @@ class JobScheduler:
                 pool.extend(m for m in assigned if w[m] > r)
             job.dispatch_pool = pool
         if changed and self.flight is not None:
-            self.flight.note(
-                "placement_apply", trigger=trigger or "periodic",
+            note = dict(
+                trigger=trigger or "periodic",
                 moves=plan.moves, excluded=",".join(plan.excluded),
             )
+            if plan.gangs:
+                note["gangs"] = ";".join(
+                    f"{j}:{w}" for j, w in sorted(plan.gangs.items())
+                )
+            self.flight.note("placement_apply", **note)
         return True
 
     def request_replan(self, reason: str) -> None:
@@ -714,6 +736,17 @@ class JobScheduler:
             return None, False
         return dict(group), set(job.assigned) == set(group)
 
+    def _job_gang(self, job: Job):
+        """{addr: rank} for an advisor-planned per-job gang (docs/
+        SHARDING.md): rank order is sorted-member order, the same order
+        ``_assign_from_plan`` stored. None while the job is solo or the
+        assignment does not (yet) match the planned width — a torn-down or
+        stale gang dispatches NOTHING until the next assignment pass, same
+        contract as the registered mesh group. Caller holds the lock."""
+        if job.gang_world < 2 or len(job.assigned) != job.gang_world:
+            return None
+        return {m: i for i, m in enumerate(sorted(job.assigned))}
+
     def _dispatch_gang(self, job_name: str, group: dict) -> int:
         """One gang shard: reserve an offset, send the SAME shard to every
         mesh process (its rank picks its slice), reassemble rank-ordered
@@ -856,11 +889,13 @@ class JobScheduler:
             by_rank: dict[int, list] = {}
             errors: list[str] = []
             method_error = False
+            lost_members = False
             for rank, fut in futures.items():
                 try:
                     # dmlc-lint: disable=L1 -- _gang_lock exists precisely to hold across this wait: two concurrent collectives over one mesh interleave participants and deadlock
                     by_rank[rank] = list(fut.result()["predictions"])
                 except RpcUnreachable as e:
+                    lost_members = True
                     errors.append(f"rank {rank}: {e}")
                 except Exception as e:
                     # The member EXECUTED and refused (rank mismatch,
@@ -868,7 +903,7 @@ class JobScheduler:
                     method_error = True
                     errors.append(f"rank {rank}: {e}")
 
-        def requeue(why: str, breaker: bool) -> int:
+        def requeue(why: str, breaker: bool, teardown: bool = False) -> int:
             log.warning("gang shard %s[%d] requeued: %s", job_name, offset, why)
             with self._lock:
                 job.outstanding.pop(offset, None)
@@ -877,6 +912,24 @@ class JobScheduler:
                     # Whole-gang retry: no member exclusion — the collective
                     # needs every process, so exclusions are meaningless.
                     job.retry_q.append((offset, set()))
+                if teardown and job.gang_world:
+                    # An advisor-planned gang lost a member: the unit is
+                    # all-or-nothing, so RELEASE the whole gang (no further
+                    # dispatch until reassigned) and force a replan — the
+                    # advisor's cached plan is stale the moment a gang
+                    # member dies, so hysteresis/budget cannot veto it.
+                    released = list(job.assigned)
+                    job.assigned = []
+                    job.dispatch_pool = []
+                    self._replan_trigger = (
+                        self._replan_trigger or f"gang_member_lost:{job_name}"
+                    )
+                    if self.flight is not None:
+                        self.flight.note(
+                            "gang_teardown", job=job_name,
+                            world=job.gang_world,
+                            released=",".join(released), why=why[:200],
+                        )
                 if breaker:
                     # Method-level refusals only: a config incompatibility
                     # (slice > engine batch cap, batch not divisible by
@@ -897,7 +950,9 @@ class JobScheduler:
             return 0
 
         if errors:
-            return requeue("; ".join(errors), breaker=method_error)
+            return requeue(
+                "; ".join(errors), breaker=method_error, teardown=lost_members
+            )
         preds: list = []
         for rank in sorted(by_rank):
             want = gang_slice(len(synsets), rank, world)
@@ -928,10 +983,21 @@ class JobScheduler:
         with self._lock:
             job = self.jobs.get(job_name)
             group, ok = self._gang_group(job) if job is not None else (None, False)
+            job_gang = (
+                self._job_gang(job)
+                if job is not None and group is None and job.gang_world
+                else None
+            )
         if group is not None:
             if not ok:
                 return 0  # mesh registered, assignment stale: next assign pass
             return self._dispatch_gang(job_name, group)
+        if job is not None and group is None and job.gang_world:
+            # Advisor-planned gang: the collective path or nothing — a solo
+            # shard would land a model that does not FIT one member.
+            if job_gang is None:
+                return 0  # torn down / stale: wait for the next assign pass
+            return self._dispatch_gang(job_name, job_gang)
         picked = self.next_shard(job_name)
         if picked is None:
             return 0
@@ -1064,6 +1130,14 @@ class JobScheduler:
                     continue
                 if gang is not None:
                     if set(j.assigned) == gang and (
+                        j.retry_q or j.next_offset < len(j.queries)
+                    ):
+                        return True
+                    continue
+                if j.gang_world:
+                    # Advisor gang: same no-hedging contract as the mesh
+                    # group; a torn-down gang has nothing dispatchable.
+                    if len(j.assigned) == j.gang_world and (
                         j.retry_q or j.next_offset < len(j.queries)
                     ):
                         return True

@@ -228,6 +228,11 @@ class PlacementPlan:
     excluded: list[str] = field(default_factory=list)
     moves: int = 0
     trigger: str = ""
+    # job -> gang width: the job's members act as ONE placement unit (a chip
+    # gang in member rank order, docs/SHARDING.md) instead of a dispatch
+    # pool. Set when the model fits NO single member's HBM headroom but an
+    # even ceil-share across `width` members fits each of them.
+    gangs: dict[str, int] = field(default_factory=dict)
 
 
 class PlacementAdvisor:
@@ -342,15 +347,15 @@ class PlacementAdvisor:
             out[m] = round(f, 3)
         return out
 
-    def _blocked_pairs(
+    def _need_and_room(
         self, jobs: list[str], members: list[str]
-    ) -> dict[str, set[str]]:
-        """job -> members that MUST NOT serve it: the member's reported HBM
-        headroom (bytes) is known and smaller than the model's known
-        analytic resident bytes. Either side unknown = unconstrained."""
-        if self.headroom is None or self.model_bytes is None:
-            return {}
+    ) -> tuple[dict[str, float], dict[str, float]]:
+        """(job -> known model resident bytes, member -> known HBM headroom
+        bytes). Unknown on either side is simply absent (never constrains)."""
         need: dict[str, float] = {}
+        room: dict[str, float] = {}
+        if self.headroom is None or self.model_bytes is None:
+            return need, room
         for job in jobs:
             try:
                 b = self.model_bytes(job)
@@ -358,9 +363,6 @@ class PlacementAdvisor:
                 b = None
             if b is not None and b > 0:
                 need[job] = float(b)
-        if not need:
-            return {}
-        room: dict[str, float] = {}
         for m in members:
             try:
                 h = self.headroom(m)
@@ -368,12 +370,52 @@ class PlacementAdvisor:
                 h = None
             if h is not None:
                 room[m] = float(h)
+        return need, room
+
+    def _blocked_pairs(
+        self, jobs: list[str], members: list[str]
+    ) -> dict[str, set[str]]:
+        """job -> members that MUST NOT serve it solo: the member's reported
+        HBM headroom (bytes) is known and smaller than the model's known
+        analytic resident bytes. Either side unknown = unconstrained."""
+        need, room = self._need_and_room(jobs, members)
         blocked: dict[str, set[str]] = {}
         for job, nbytes in need.items():
             bad = {m for m, h in room.items() if h < nbytes}
             if bad:
                 blocked[job] = bad
         return blocked
+
+    def _gang_plan(
+        self,
+        job: str,
+        eligible: list[str],
+        costs: dict[str, float],
+        chip_weight: dict[str, int],
+        need_bytes: float,
+        room: dict[str, float],
+    ) -> tuple[list[str], int] | None:
+        """Trade replica count against shard width for a job NO single
+        member can hold: the SMALLEST width whose even ceil-share of the
+        model's resident bytes fits each chosen member's known headroom
+        (minimal width leaves the most replica capacity for every other
+        job). Members are chosen by cost-lane capacity — chip weight over
+        measured dispatch cost — so the gang lands on the members that can
+        actually feed it; unknown headroom never blocks, mirroring
+        ``_blocked_pairs``. None when even the widest gang cannot fit."""
+        ranked = sorted(
+            eligible,
+            key=lambda m: (
+                -chip_weight.get(m, 1) / max(1e-9, costs.get(m, 1.0)),
+                m,
+            ),
+        )
+        for width in range(2, len(ranked) + 1):
+            share = need_bytes / width
+            fits = [m for m in ranked if room.get(m, float("inf")) >= share]
+            if len(fits) >= width:
+                return fits[:width], width
+        return None
 
     def _exclusions(self, costs: dict[str, float], median: float) -> set[str]:
         """Sticky outlier set: enter above ``exclude_factor`` x median,
@@ -453,7 +495,34 @@ class PlacementAdvisor:
         if blocked and self.metrics is not None:
             self.metrics.inc("placement_headroom_blocked")
 
-        plan = self._solve(jobs, eligible, costs, chip_weight, blocked)
+        # Gang formation (docs/SHARDING.md): a job every eligible member is
+        # blocked for is NOT refused — it becomes a chip gang wide enough
+        # that each member's ceil-share of the model fits its headroom. Gang
+        # jobs leave the solo solver (their members stay eligible for other
+        # jobs' dispatch pools; the scheduler keeps the flows separate).
+        need, room = self._need_and_room(sorted(jobs), sorted(members))
+        gang_assign: dict[str, list[str]] = {}
+        gang_width: dict[str, int] = {}
+        solo_jobs = dict(jobs)
+        for job in sorted(jobs):
+            bad = blocked.get(job)
+            if not bad or not eligible or not set(eligible) <= bad:
+                continue
+            got = self._gang_plan(
+                job, eligible, costs, chip_weight, need[job], room
+            )
+            if got is None:
+                continue  # truly unplaceable: _solve leaves it memberless
+            gang_assign[job], gang_width[job] = got
+            del solo_jobs[job]
+            if self.metrics is not None:
+                self.metrics.inc("placement_gangs_formed")
+
+        plan = self._solve(solo_jobs, eligible, costs, chip_weight, blocked)
+        for job, gang_members in gang_assign.items():
+            plan.assignment[job] = list(gang_members)
+            plan.weights[job] = {}
+            plan.gangs[job] = gang_width[job]
         plan.excluded = sorted(excluded)
         plan.trigger = trigger
 
@@ -478,7 +547,8 @@ class PlacementAdvisor:
             set(plan.excluded) != set(previous.excluded)
         )
         if usable and not excluded_changed:
-            if plan.moves == 0 and plan.assignment == previous.assignment:
+            if (plan.moves == 0 and plan.assignment == previous.assignment
+                    and plan.gangs == previous.gangs):
                 return previous  # identical assignment: keep the cached object
             # Hysteresis: a reshuffle must buy a real improvement.
             old_est = self._plan_estimate(previous, jobs, costs, chip_weight)
@@ -522,6 +592,13 @@ class PlacementAdvisor:
                 # starved job must see WHICH members were refused (lint O2).
                 note["headroom_blocked"] = ";".join(
                     f"{j}={','.join(sorted(ms))}" for j, ms in sorted(blocked.items())
+                )
+            if plan.gangs:
+                # A gang is the plan's most consequential shape: which job
+                # went multi-chip, how wide, on whom (lint O2).
+                note["gangs"] = ";".join(
+                    f"{j}:{w}={','.join(plan.assignment[j])}"
+                    for j, w in sorted(plan.gangs.items())
                 )
             self.flight.note("placement_decision", **note)
         return plan
@@ -623,6 +700,7 @@ class PlacementAdvisor:
             "assignment": {} if plan is None else {
                 n: list(ms) for n, ms in sorted(plan.assignment.items())
             },
+            "gangs": {} if plan is None else dict(sorted(plan.gangs.items())),
         }
 
 

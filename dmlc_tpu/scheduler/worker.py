@@ -565,6 +565,154 @@ class EngineBackend:
             self._ensure_engine().load_variables(variables)
 
 
+class LmBackend:
+    """Gang-sharded causal-LM serving backend (docs/SHARDING.md).
+
+    A "synset" on a ``kind="lm"`` job is a PROMPT ID: the encoding is the
+    deterministic arithmetic in ``parallel.sharding.tokens_for_prompt``, so
+    the leader, every gang member, and the single-process reference agree on
+    the token stream byte-for-byte, and the predicted "class index" is the
+    argmax next-token id — the existing job.predict accuracy accounting then
+    measures exact TOKEN IDENTITY against reference labels.
+
+    The compiled program comes from the partition-rule engine: one rule
+    table, compiled at whatever gang width the PlacementAdvisor chose
+    (``plan_axes`` splits the width into dp x tp). Solo ``__call__`` REFUSES
+    when the model's resident bytes exceed this chip's HBM budget — the
+    refusal the advisor converts into a wide gang instead of a dead job.
+    ``predict_gang`` serves a rank's contiguous ``gang_slice`` of the shard
+    from a program sharded across the gang's chips, so per-chip residency is
+    ``sharded_bytes_per_chip`` — under the budget the solo path refused at.
+    """
+
+    def __init__(
+        self,
+        model_name: str,
+        *,
+        gang_devices: int = 0,
+        prompt_len: int = 16,
+        dtype=None,
+        hbm_budget_bytes: int = 0,
+        device_work=None,
+        devices=None,
+    ):
+        self.model_name = model_name
+        self.prompt_len = prompt_len
+        # Fixed gang width (config lm_gang_devices); 0 = follow the
+        # scheduler's world size, clamped to the local chip count.
+        self.gang_devices = gang_devices
+        # Per-chip resident-bytes budget enforced on the SOLO path; 0 = no
+        # budget (model fits anywhere). The test harness sets this below
+        # lm_wide's bytes so the model only serves sharded.
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self.device_work = device_work
+        self._devices = devices
+        self._dtype = dtype
+        self._programs: dict[int, object] = {}
+        self._lock = threading.Lock()
+
+    def _resolve_devices(self) -> list:
+        if self._devices is not None:
+            return list(self._devices)
+        import jax
+
+        return list(jax.devices())
+
+    def _program(self, width: int):
+        import jax.numpy as jnp
+
+        from dmlc_tpu.models.registry import get_model
+        from dmlc_tpu.parallel import sharding as sharding_lib
+        from dmlc_tpu.parallel.mesh import make_mesh
+
+        devs = self._resolve_devices()
+        width = max(1, min(width, len(devs)))
+        prog = self._programs.get(width)
+        if prog is None:
+            spec = get_model(self.model_name)
+            axes = sharding_lib.plan_axes(width, num_heads=spec.num_heads)
+            mesh = make_mesh(axes, devices=devs[:width])
+            prog = sharding_lib.ShardedProgram(
+                self.model_name, mesh, dtype=self._dtype or jnp.float32
+            )
+            self._programs[width] = prog
+        return prog
+
+    def warmup(self) -> None:
+        """Build + compile now, BEFORE the membership loops begin (same
+        GIL-starvation rationale as EngineBackend.warmup)."""
+        with self._lock:
+            self._program(self.gang_devices or 1)
+
+    def _run(self, prog, synsets: Sequence[str]) -> list[int]:
+        from dmlc_tpu.parallel import sharding as sharding_lib
+
+        spec = prog.spec  # registry ModelSpec: input_size=max_len, num_outputs=vocab
+        tokens = sharding_lib.encode_prompts(
+            list(synsets), min(self.prompt_len, spec.input_size), spec.num_outputs
+        )
+        t0 = time.monotonic()
+        out = prog.run(tokens)
+        if self.device_work is not None:
+            self.device_work(self.model_name, len(synsets), time.monotonic() - t0)
+        return [int(x) for x in out]
+
+    def __call__(self, synsets: Sequence[str]) -> list[int]:
+        with self._lock:
+            if self.hbm_budget_bytes > 0:
+                import jax.numpy as jnp
+
+                from dmlc_tpu.models.registry import get_model
+
+                need = get_model(self.model_name).param_bytes(
+                    self._dtype or jnp.float32
+                )
+                if need > self.hbm_budget_bytes:
+                    raise RpcError(
+                        f"model {self.model_name!r} needs {need} resident bytes, "
+                        f"over this chip's {self.hbm_budget_bytes} HBM budget; "
+                        f"serve it as a gang (docs/SHARDING.md)"
+                    )
+            return self._run(self._program(1), synsets)
+
+    def predict_gang(self, synsets: Sequence[str], rank: int, world: int) -> list[int]:
+        """This rank's contiguous slice of a gang shard, computed by the
+        rule-sharded program at the gang's width. Unlike EngineBackend's
+        multi-process SPMD path there is no collective-entry symmetry to
+        keep — each rank's slice is an independent device execution over
+        chip-sharded weights — so an empty slice just answers []."""
+        with self._lock:
+            prog = self._program(self.gang_devices or world)
+            start, stop = gang_slice(len(synsets), rank, world)
+            mine = list(synsets[start:stop])
+            if not mine:
+                return []
+            return self._run(prog, mine)
+
+    def load_variables(self, variables) -> None:
+        """Hot-swap weights (the `train` verb): every cached width re-shards
+        the same host tree under the model's rule table."""
+        with self._lock:
+            for prog in self._programs.values():
+                prog.load_variables(variables)
+
+    def resident_bytes(self) -> int | None:
+        """Per-chip resident weight bytes of the WIDEST built program — the
+        number the leader's HBM gauges see, so the advisor's headroom math
+        reflects the sharded (post-gang) footprint, not the replicated one.
+        None until a program builds (same contract as engine gauges)."""
+        from dmlc_tpu.parallel import sharding as sharding_lib
+
+        if not self._programs:
+            return None
+        prog = self._programs[max(self._programs)]
+        return int(
+            sharding_lib.sharded_bytes_per_chip(
+                self.model_name, prog.mesh, dtype=prog.dtype
+            )
+        )
+
+
 class ExportedBackend:
     """Serve shards from the SDFS-distributed StableHLO artifact + weights —
     NO model source code on the serving path. This is the deployed form of

@@ -219,8 +219,20 @@ class ClusterConfig:
     # corpus — the BASELINE "4-node SDFS shard" configuration.
     data_from_sdfs: bool = False
     # The reference's two static jobs (src/services.rs:168-169); any registry
-    # model name works here.
+    # model name works here. kind="lm" registry entries (lm_small, lm_wide)
+    # serve through the gang-aware LmBackend (docs/SHARDING.md).
     job_models: list[str] = field(default_factory=lambda: ["resnet18", "alexnet"])
+    # --- gang-sharded LM serving (parallel/sharding.py, docs/SHARDING.md) -
+    # lm_gang_devices pins the tensor/data mesh width an LM job uses
+    # when dispatched as a gang (0 = the advisor-planned gang world size).
+    # lm_hbm_budget_bytes is the per-chip resident budget the solo path
+    # enforces: an LM whose replicated weights exceed it refuses solo
+    # service with a typed error, steering the PlacementAdvisor toward a
+    # gang (0 = no budget, solo always allowed). lm_prompt_len bounds the
+    # synthetic prompt length encoded per query id.
+    lm_gang_devices: int = 0
+    lm_prompt_len: int = 16
+    lm_hbm_budget_bytes: int = 0
     # Compile engines at node startup, before membership begins (the
     # reference's eager model load, src/services.rs:513-524). Lazy loading
     # risks compile-time GIL holds starving the heartbeat threads.

@@ -676,6 +676,81 @@ def test_a8_declared_axes_and_matching_rank_are_clean(tmp_path):
         [f.message for f in findings]
 
 
+def test_a8_dead_partition_rules(tmp_path):
+    # Rules behind the catch-all and duplicate patterns are dead: first
+    # match wins (parallel/sharding.match_partition_rules), so they can
+    # never fire — a param the author meant to shard silently replicates.
+    src = """
+        from jax.sharding import PartitionSpec as P
+
+        RULES = (
+            (r"kernel$", P(None, "tp")),
+            (r".*", P()),
+            (r"bias$", P("tp")),
+        )
+        DUP_RULES = (
+            (r"kernel$", P(None, "tp")),
+            (r"kernel$", P("tp", None)),
+            (r".*", P()),
+        )
+    """
+    findings = analyze(tmp_path, "fxa8d", {"m.py": src})
+    a8 = sorted(
+        (f for f in findings if f.rule == "A8"), key=lambda f: f.line
+    )
+    assert len(a8) == 2, [f.message for f in findings]
+    assert "shadowed by catch-all" in a8[0].message
+    assert "'bias$'" in a8[0].message
+    assert "duplicates entry 0" in a8[1].message
+
+
+def test_a8_rule_table_without_catchall_and_bad_regex(tmp_path):
+    # No terminal catch-all = spec-less params at mesh>1; a non-compiling
+    # regex can never match, so its spec is unreachable.
+    src = """
+        from jax.sharding import PartitionSpec as P
+
+        NO_CATCHALL = (
+            (r"kernel$", P(None, "tp")),
+            (r"bias$", P("tp")),
+        )
+        BAD_REGEX = (
+            (r"kernel[", P(None, "tp")),
+            (r".*", P()),
+        )
+    """
+    findings = analyze(tmp_path, "fxa8n", {"m.py": src})
+    a8 = sorted(
+        (f for f in findings if f.rule == "A8"), key=lambda f: f.line
+    )
+    assert len(a8) == 2, [f.message for f in findings]
+    assert "no terminal catch-all" in a8[0].message
+    assert "spec-less" in a8[0].message.lower()
+    assert "does not compile" in a8[1].message
+
+
+def test_a8_healthy_rule_table_and_non_tables_are_clean(tmp_path):
+    # The repo grammar (ordered rules, terminal catch-all) passes clean,
+    # and tuples that merely LOOK pair-shaped but are not (str, P(...))
+    # throughout are some other data structure — stay silent.
+    src = """
+        from jax.sharding import PartitionSpec as P
+
+        RULES = (
+            (r"(query|key|value)/kernel$", P(None, "tp")),
+            (r"out/kernel$", P("tp", None)),
+            (r".*", P()),
+        )
+        NOT_A_TABLE = (
+            ("verb", object()),
+            ("other", object()),
+        )
+    """
+    findings = analyze(tmp_path, "fxa8h", {"m.py": src})
+    assert [f for f in findings if f.rule == "A8"] == [], \
+        [f.message for f in findings]
+
+
 def test_a8_parameter_mesh_stays_silent(tmp_path):
     # The under-approximation contract: a mesh that arrives as a parameter
     # has unknown axes, so nothing is provable and nothing fires.

@@ -352,7 +352,7 @@ def test_committed_artifact_has_all_sections_and_history():
     cite: every section present and non-empty, history_best populated."""
     detail = json.loads((bench.Path(__file__).parents[1] / "bench_detail.json").read_text())
     for key in ("configs", "e2e", "batch_curve", "flash", "train", "history_best",
-                "roofline_notes", "device"):
+                "roofline_notes", "device", "sharded"):
         assert detail.get(key), f"bench_detail.json[{key!r}] missing or empty"
     assert detail["history_best"].get("resnet18@1024", {}).get(
         "images_per_sec_per_chip", 0
@@ -369,6 +369,20 @@ def test_committed_artifact_has_all_sections_and_history():
     for name, leg in device["legs"].items():
         assert leg.get("compiles", 0) >= 0, name
         assert "peak_hbm_bytes" in leg, name  # present; None off-TPU
+    # Sharded leg (ISSUE 17): the gang entry must record WHERE it ran
+    # (platform + virtual_devices — the CLIP 2-chip 'speedup' on a 1-core
+    # virtual mesh is honest, not a regression), that the gang result is
+    # token-identical to the mesh-of-1 reference, and that sharding
+    # actually shrank the per-chip resident footprint.
+    gang = detail["sharded"]["lm_wide_gang"]
+    assert gang["gang"] >= 2
+    assert gang["token_identical_vs_ref"] is True
+    assert gang["predictions_per_sec"] > 0
+    assert gang["per_chip_resident_bytes"] < gang["replicated_bytes"]
+    assert gang["platform"] and "virtual_devices" in gang
+    tp = detail["sharded"]["clip_tp"]
+    assert tp["img_s_1chip"] > 0 and tp["img_s_2chip"] > 0
+    assert tp["speedup_2chip"] > 0 and "virtual_devices" in tp
 
 
 def test_bench_py_compiles():
@@ -624,6 +638,73 @@ class TestLmDecodeGuard:
         out = bench.annotate_lm_decode_entries(
             {"continuous8": {"tokens_per_sec": 240.0}}, {})
         assert "degraded_vs_history" not in out["continuous8"]
+
+
+class TestShardedGuard:
+    """ISSUE 17: the gang-sharded leg is guarded like flash/train/lm_decode,
+    and history resets whenever the mesh geometry OR platform changed — a
+    first silicon capture must never be judged against virtual-device CPU
+    numbers (where the 2-chip CLIP 'speedup' is honestly < 1) or vice versa."""
+
+    OLD = {
+        "lm_wide_gang": {"platform": "cpu", "devices": 8, "virtual_devices": True,
+                         "model": "lm_wide", "gang": 4, "batch": 16, "prompt": 32,
+                         "predictions_per_sec": 154.3,
+                         "token_identical_vs_ref": True,
+                         "per_chip_resident_bytes": 9741312,
+                         "replicated_bytes": 25485312},
+        "clip_tp": {"platform": "cpu", "devices": 8, "virtual_devices": True,
+                    "model": "clip_vit_l14", "batch": 4,
+                    "img_s_1chip": 0.43, "img_s_2chip": 0.40,
+                    "speedup_2chip": 0.939},
+    }
+
+    def test_collapsed_gang_rate_flagged_and_merge_keeps_healthy(self):
+        new = bench.annotate_sharded_entries(
+            {"lm_wide_gang": dict(self.OLD["lm_wide_gang"],
+                                  predictions_per_sec=12.0)},
+            self.OLD)
+        assert new["lm_wide_gang"]["degraded_vs_history"] is True
+        assert new["lm_wide_gang"]["best_predictions_per_sec"] == 154.3
+        merged = bench.merge_detail({"configs": [], "sharded": new},
+                                    {"configs": [], "sharded": self.OLD})
+        assert merged["sharded"]["lm_wide_gang"]["predictions_per_sec"] == 154.3
+        assert merged["sharded"]["lm_wide_gang"]["stale"] is True
+
+    def test_healthy_advances_best_on_both_clip_legs(self):
+        new = bench.annotate_sharded_entries(
+            {"clip_tp": dict(self.OLD["clip_tp"], img_s_1chip=0.5,
+                             img_s_2chip=0.9, speedup_2chip=1.8)},
+            self.OLD)
+        e = new["clip_tp"]
+        assert "degraded_vs_history" not in e
+        assert e["best_img_s_1chip"] == 0.5 and e["best_img_s_2chip"] == 0.9
+
+    def test_platform_or_geometry_change_resets_history(self):
+        # First TPU capture: 10x the CPU rate either way, judged fresh.
+        tpu = bench.annotate_sharded_entries(
+            {"lm_wide_gang": dict(self.OLD["lm_wide_gang"], platform="tpu",
+                                  devices=4, virtual_devices=False,
+                                  predictions_per_sec=15.0)},
+            self.OLD)
+        assert "degraded_vs_history" not in tpu["lm_wide_gang"]
+        assert tpu["lm_wide_gang"]["best_predictions_per_sec"] == 15.0
+        wider = bench.annotate_sharded_entries(
+            {"lm_wide_gang": dict(self.OLD["lm_wide_gang"], gang=8,
+                                  predictions_per_sec=60.0)},
+            self.OLD)
+        assert "degraded_vs_history" not in wider["lm_wide_gang"]
+
+    def test_skipped_leg_keeps_previous_stamped_stale(self):
+        merged = bench.merge_detail({"configs": [], "sharded": {}},
+                                    {"configs": [], "sharded": self.OLD})
+        assert merged["sharded"]["clip_tp"]["img_s_2chip"] == 0.40
+        assert merged["sharded"]["clip_tp"]["stale"] is True
+
+    def test_no_history_never_flags(self):
+        out = bench.annotate_sharded_entries(
+            {"lm_wide_gang": {"model": "lm_wide", "predictions_per_sec": 1.0}}, {})
+        assert "degraded_vs_history" not in out["lm_wide_gang"]
 
 
 class TestDeviceLegs:

@@ -33,7 +33,7 @@ from dmlc_tpu.scheduler.placement import (
     SloEvaluator,
     SloObjective,
 )
-from dmlc_tpu.scheduler.worker import PredictWorker
+from dmlc_tpu.scheduler.worker import PredictWorker, gang_slice
 from dmlc_tpu.utils.metrics import Counters
 
 SEED_BASE = int(os.environ.get("DMLC_CHAOS_SEED", "0"))
@@ -663,3 +663,207 @@ class TestHeadroomHardConstraint:
         adv = self._advisor(boom, lambda j: 2e9)
         plan = adv.advise({"job": 100}, ["m0", "m1"])
         assert sorted(plan.assignment["job"]) == ["m0", "m1"]
+
+
+# ---------------------------------------------------------------------------
+# Gang-sharded placement (ISSUE 17, docs/SHARDING.md): a model that fits NO
+# single member's HBM becomes a chip gang, not a refusal
+# ---------------------------------------------------------------------------
+
+
+class GangEchoBackend:
+    """Gang-capable fake: ``predict_gang`` answers this rank's contiguous
+    slice; solo dispatch of the over-HBM model is a bug, so ``__call__``
+    fails loudly (the real LmBackend refuses with a typed RpcError)."""
+
+    def __call__(self, synsets):
+        raise AssertionError("over-HBM model must never be dispatched solo")
+
+    def predict_gang(self, synsets, rank, world):
+        start, stop = gang_slice(len(synsets), rank, world)
+        return [int(s[1:]) for s in synsets[start:stop]]
+
+
+class TestGangPlacement:
+    """Over-HBM models gang instead of starving: the advisor trades replica
+    count against shard width from the same cost lanes and HBM gauges the
+    solo path uses."""
+
+    def _advisor(self, headroom, model_bytes, costs=None, **kw):
+        clock = VClock()
+        prof = make_profiler(clock)
+        adv = PlacementAdvisor(
+            prof, clock=clock, headroom=headroom, model_bytes=model_bytes, **kw
+        )
+        feed(prof, costs or {"m0": 0.1, "m1": 0.1, "m2": 0.1, "m3": 0.1})
+        return adv
+
+    def test_over_hbm_job_gets_a_gang_not_a_refusal(self):
+        clock = VClock()
+        flight = FlightRecorder(clock=clock)
+        metrics = Counters()
+        # 25 MB model, 10 MB headroom everywhere: solo is impossible on
+        # every member, but a 3-wide gang's ~8.3 MB share fits each.
+        adv = self._advisor(
+            lambda m: 10e6, {"lm": 25e6, "small": 1e6}.get,
+            flight=flight, metrics=metrics,
+        )
+        plan = adv.advise({"lm": 50, "small": 50}, ["m0", "m1", "m2", "m3"])
+        assert plan.gangs == {"lm": 3}
+        assert len(plan.assignment["lm"]) == 3
+        assert plan.weights["lm"] == {}  # gangs have no dispatch pool
+        assert metrics.get("placement_gangs_formed") == 1
+        # The small job still places solo; it did not inherit gang shape.
+        assert plan.assignment["small"] and "small" not in plan.gangs
+        assert adv.status()["gangs"] == {"lm": 3}
+        # The decision is reconstructible from the recorder (lint O2).
+        note = [
+            e for e in flight.events() if e["kind"] == "placement_decision"
+        ][-1]
+        assert note["gangs"].startswith("lm:3=")
+
+    def test_gang_width_is_minimal_feasible(self):
+        # 40 MB over 25 MB headroom: a 2-wide share (20 MB) already fits,
+        # so the advisor must NOT burn a third chip on this job.
+        adv = self._advisor(lambda m: 25e6, {"lm": 40e6}.get)
+        plan = adv.advise({"lm": 10}, ["m0", "m1", "m2", "m3"])
+        assert plan.gangs == {"lm": 2}
+
+    def test_gang_members_follow_cost_lane_capacity(self):
+        # m0's dispatch lane runs 2x the fleet cost (still under the
+        # exclusion line): the 3-wide gang must land on the three members
+        # whose lanes can actually feed it.
+        adv = self._advisor(
+            lambda m: 10e6, {"lm": 25e6}.get,
+            costs={"m0": 0.2, "m1": 0.1, "m2": 0.1, "m3": 0.1},
+        )
+        plan = adv.advise({"lm": 10}, ["m0", "m1", "m2", "m3"])
+        assert plan.gangs["lm"] == 3
+        assert "m0" not in plan.assignment["lm"]
+
+    def test_gang_members_follow_chip_weights(self):
+        # Equal costs, but m3 advertises 4 chips: capacity = chips/cost
+        # puts it first in the gang.
+        adv = self._advisor(lambda m: 13e6, {"lm": 25e6}.get)
+        plan = adv.advise(
+            {"lm": 10}, ["m0", "m1", "m2", "m3"],
+            chip_weight={"m0": 1, "m1": 1, "m2": 1, "m3": 4},
+        )
+        assert plan.gangs["lm"] == 2
+        assert "m3" in plan.assignment["lm"]
+
+    def test_truly_unplaceable_job_still_gets_no_members(self):
+        # Even the widest gang cannot shard 100 MB into 10 MB headrooms
+        # across two members: empty assignment remains the honest answer.
+        adv = self._advisor(lambda m: 10e6, {"lm": 100e6}.get)
+        plan = adv.advise({"lm": 10}, ["m0", "m1"])
+        assert plan.assignment["lm"] == []
+        assert plan.gangs == {}
+
+
+class GangFixture:
+    """Four gang-capable members on the sim fabric with headroom gauges too
+    small for the model solo — wired like cluster/node.py wires the leader,
+    driven on the virtual clock."""
+
+    def __init__(self, n_members: int = 4, n_queries: int = 64, shard: int = 8):
+        self.net = SimRpcNetwork()
+        self.members = [f"m{i}" for i in range(n_members)]
+        for m in self.members:
+            self.net.serve(
+                m, PredictWorker({"lm": GangEchoBackend()}).methods()
+            )
+        self.flight = FlightRecorder(clock=self.net.clock)
+        self.metrics = Counters()
+        self.profiler = CostProfiler(
+            window_s=5.0, windows=8, decay=0.5, clock=self.net.clock
+        )
+        self.advisor = PlacementAdvisor(
+            self.profiler, flight=self.flight, metrics=self.metrics,
+            clock=self.net.clock,
+            headroom=lambda m: 10e6, model_bytes={"lm": 25e6}.get,
+        )
+        feed(self.profiler, {m: 0.1 for m in self.members}, model="lm")
+        self.scheduler = JobScheduler(
+            self.net.client("L"),
+            lambda: list(self.members),
+            jobs={"lm": [(f"p{i}", i) for i in range(n_queries)]},
+            shard_size=shard,
+            shard_timeout_s=5.0,
+            timer=self.net.clock,
+            hedge_tail=False,
+            metrics=self.metrics,
+            flight=self.flight,
+            profiler=self.profiler,
+            advisor=self.advisor,
+        )
+        self.scheduler.is_leading = True
+
+    def step(self) -> None:
+        self.scheduler.assign_once()
+        if self.scheduler.dispatch_all_once() == 0:
+            self.net.advance(0.05)
+
+    def run_until(self, pred, budget_s: float = 60.0) -> bool:
+        deadline = self.net.now + budget_s
+        while self.net.now < deadline:
+            self.step()
+            if pred():
+                return True
+        return False
+
+
+class TestGangDispatch:
+    def test_over_hbm_model_serves_through_the_gang_path(self):
+        f = GangFixture()
+        f.scheduler._start({})
+        job = f.scheduler.jobs["lm"]
+        assert job.gang_world == 3
+        assert f.run_until(lambda: job.done), job.report()
+        assert job.accuracy == 1.0
+        assert job.gang_shards == 8  # 64 queries / shard 8, all collective
+        # Solo predict never fired: every dispatch was the gang verb.
+        assert all(m != "job.predict" for _, m in f.net.calls)
+
+    @pytest.mark.parametrize("seed", seeds(3))
+    def test_gang_member_death_tears_down_and_replans(self, seed):
+        f = GangFixture()
+        f.scheduler._start({})
+        job = f.scheduler.jobs["lm"]
+        gang = list(job.assigned)
+        assert job.gang_world == 3 and len(gang) == 3
+
+        # Phase 1 — healthy gang serves a few collective shards.
+        assert f.run_until(lambda: job.gang_shards >= 2), job.report()
+
+        # Phase 2 — kill one member MID-STREAM (chaos-seeded choice). The
+        # in-flight shard fails with the typed unreachable error, the whole
+        # gang is released (all-or-nothing), and a replan is forced.
+        victim = random.Random(seed).choice(gang)
+        f.net.crash(victim)
+        assert f.run_until(
+            lambda: any(
+                e["kind"] == "gang_teardown" for e in f.flight.events()
+            ),
+            budget_s=30.0,
+        ), "gang teardown never recorded"
+        tear = [e for e in f.flight.events() if e["kind"] == "gang_teardown"][0]
+        assert tear["job"] == "lm" and tear["world"] == 3
+        assert set(tear["released"].split(",")) == set(gang)
+        assert "unreachable" in tear["why"].lower()
+
+        # Phase 3 — failure detection removes the member; the advisor
+        # re-forms the gang from survivors and the stream drains with no
+        # hung dispatches and full accuracy.
+        f.members.remove(victim)
+        assert f.run_until(lambda: job.done, budget_s=120.0), job.report()
+        assert job.accuracy == 1.0
+        assert victim not in job.assigned
+        assert job.gang_world == 3 and len(job.assigned) == 3
+        assert not job.outstanding, "hung gang dispatches left behind"
+        # The replan is attributable: teardown forced its own trigger.
+        assert any(
+            e["kind"] == "placement_decision"
+            and e.get("trigger", "").startswith(("gang_member_lost", "membership"))
+            for e in f.flight.events()
+        )

@@ -25,7 +25,11 @@ the space BETWEEN the jit construction and its call sites:
   ``NamedSharding`` and collectives (``psum``/``pmean``/``axis_index``…)
   must be declared by the statically-known enclosing mesh; spec rank must
   not exceed derivable operand rank; ``in_specs`` arity must match the
-  immediate call's operand count.
+  immediate call's operand count. Partition-rule tables (literal
+  ``(regex, PartitionSpec)`` sequences, parallel/sharding.py grammar) must
+  have compiling regexes, no rule dead behind a catch-all or duplicate
+  (first match wins), and a terminal catch-all — without one, params
+  matched by no rule are spec-less at mesh>1.
 
 Precedence with lint (one finding never fires twice): J1 owns host syncs
 *inside* jit-wrapped functions in its scope (parallel/, ops/) — A7 skips
@@ -791,6 +795,7 @@ class _A8:
                     elif name.rsplit(".", 1)[-1] == "NamedSharding":
                         self._check_named_sharding(analysis, dm, fd, node)
             self._check_collectives(analysis, dm, mod, shard_calls)
+            self._check_rule_tables(analysis, mod)
 
     # -- shard_map sites ---------------------------------------------------
 
@@ -919,6 +924,94 @@ class _A8:
                     f"NamedSharding spec names axis {axis!r} but the mesh "
                     f"declares {md.axes}",
                     (Step(md.relpath, md.line, "mesh defined here", False),),
+                ))
+
+    # -- partition-rule tables (parallel/sharding.py grammar) --------------
+    #
+    # A rule table is a literal tuple/list of (regex-string, PartitionSpec)
+    # pairs, matched first-match-wins against '/'-joined param paths
+    # (sharding.match_partition_rules). Static defects found here are
+    # SILENT at runtime until the wrong mesh shape: a dead rule means some
+    # param silently falls through to a later (usually replicated) spec,
+    # and a table with no terminal catch-all leaves params spec-less at
+    # mesh>1 — strict matching raises, non-strict silently replicates.
+    # The dynamic complement is sharding.validate_rules, which checks a
+    # table against a REAL param tree; this static half needs no model.
+
+    def _rule_table_entries(self, value, imports):
+        """[(pattern_const, spec_call)] when ``value`` is a literal rule
+        table, else None. Every element must fit the grammar — one odd
+        element means it is some other data structure, stay silent."""
+        if not isinstance(value, (ast.Tuple, ast.List)) or not value.elts:
+            return None
+        entries = []
+        for e in value.elts:
+            if (not isinstance(e, (ast.Tuple, ast.List)) or len(e.elts) != 2
+                    or not isinstance(e.elts[0], ast.Constant)
+                    or not isinstance(e.elts[0].value, str)
+                    or not isinstance(e.elts[1], ast.Call)
+                    or not _is_spec_call(e.elts[1], imports)):
+                return None
+            entries.append((e.elts[0], e.elts[1]))
+        return entries
+
+    def _check_rule_tables(self, analysis: Analysis, mod) -> None:
+        import re as re_mod
+
+        # Rule tables are declared as module- or class-level constants; a
+        # full ast.walk here measurably blows the whole-tree runtime budget.
+        stmts = list(mod.tree.body)
+        stmts.extend(
+            s for n in mod.tree.body if isinstance(n, ast.ClassDef)
+            for s in n.body
+        )
+        for node in stmts:
+            if not isinstance(node, ast.Assign):
+                continue
+            entries = self._rule_table_entries(node.value, mod.imports)
+            if entries is None:
+                continue
+            seen: dict[str, int] = {}
+            catchall: tuple[int, str] | None = None
+            for i, (pat_node, _spec) in enumerate(entries):
+                pat = pat_node.value
+                try:
+                    re_mod.compile(pat)
+                except re_mod.error as exc:
+                    analysis.findings.append(Finding(
+                        mod.relpath, pat_node.lineno, pat_node.col_offset,
+                        self.id,
+                        f"partition rule regex {pat!r} does not compile: "
+                        f"{exc} — every param matches a LATER rule or none",
+                    ))
+                    continue
+                if catchall is not None:
+                    analysis.findings.append(Finding(
+                        mod.relpath, pat_node.lineno, pat_node.col_offset,
+                        self.id,
+                        f"partition rule {pat!r} is dead: shadowed by "
+                        f"catch-all {catchall[1]!r} at entry {catchall[0]} "
+                        f"(first match wins)",
+                    ))
+                    continue
+                if pat in seen:
+                    analysis.findings.append(Finding(
+                        mod.relpath, pat_node.lineno, pat_node.col_offset,
+                        self.id,
+                        f"partition rule {pat!r} duplicates entry {seen[pat]}"
+                        f" — the later rule is dead (first match wins)",
+                    ))
+                    continue
+                seen[pat] = i
+                if pat in ("", ".*"):
+                    catchall = (i, pat)
+            if catchall is None:
+                analysis.findings.append(Finding(
+                    mod.relpath, node.lineno, node.col_offset, self.id,
+                    "partition rule table has no terminal catch-all "
+                    "('.*'): params matched by no rule are SPEC-LESS at "
+                    "mesh>1 (strict matching raises; non-strict silently "
+                    "replicates)",
                 ))
 
     # -- collectives -------------------------------------------------------

@@ -22,8 +22,9 @@
 #                         replayable witness
 #   3. ruff             — generic Python lint (ruff.toml)
 #   4. mypy --strict    — types, strict on dmlc_tpu/cluster/,
-#                         dmlc_tpu/generate/, and
-#                         dmlc_tpu/scheduler/placement.py (incremental
+#                         dmlc_tpu/generate/,
+#                         dmlc_tpu/scheduler/placement.py, and
+#                         dmlc_tpu/parallel/sharding.py (incremental
 #                         adoption: other packages are not yet
 #                         annotation-complete)
 #   5. clang-tidy       — native/*.cpp static analysis (.clang-tidy)
@@ -50,7 +51,12 @@
 #                         force-sampled into the merged fleet trace, and
 #                         leader scrape cost held the 4*sqrt(N) tree
 #                         bound; one leg per chaos seed base
-#  11. chaos matrix     — the seeded fault-injection suites (crashes,
+#  11. gang smoke       — sharded predict at 3 and 8 virtual devices must
+#                         be token-identical to the mesh-of-1 reference
+#                         and every served rule table must audit healthy
+#                         (__graft_entry__.gang_smoke, docs/SHARDING.md);
+#                         one leg per chaos seed base
+#  12. chaos matrix     — the seeded fault-injection suites (crashes,
 #                         partitions, failover, disk bit-rot/torn writes,
 #                         overload: deadlines/shedding/breakers/gray
 #                         ejection, the generation join/leave soak with
@@ -102,10 +108,10 @@ else
   note "ruff SKIPPED (not installed in this image)"
 fi
 
-note "mypy (strict on dmlc_tpu/cluster/ + dmlc_tpu/generate/ + dmlc_tpu/scheduler/placement.py)"
+note "mypy (strict on dmlc_tpu/cluster/ + dmlc_tpu/generate/ + dmlc_tpu/scheduler/placement.py + dmlc_tpu/parallel/sharding.py)"
 if command -v mypy >/dev/null 2>&1 || python -c "import mypy" >/dev/null 2>&1; then
   python -m mypy --strict dmlc_tpu/cluster/ dmlc_tpu/generate/ \
-    dmlc_tpu/scheduler/placement.py || fail=1
+    dmlc_tpu/scheduler/placement.py dmlc_tpu/parallel/sharding.py || fail=1
 else
   note "mypy SKIPPED (not installed in this image)"
 fi
@@ -163,6 +169,14 @@ for seed_base in 0 1000 2000; do
     note "loadgen smoke $seed_base OK (/tmp/slo_cert_$seed_base.json)"
   else
     note "loadgen smoke $seed_base FAILED (replay: python tools/slo_cert.py --seed $seed_base --out /tmp/slo_cert_$seed_base.json)"
+    fail=1
+  fi
+  note "gang smoke DMLC_CHAOS_SEED=$seed_base (sharded predict vs mesh-of-1 reference at 3 and 8 virtual devices, docs/SHARDING.md)"
+  if env DMLC_CHAOS_SEED="$seed_base" python -c \
+      "import __graft_entry__ as g; g.gang_smoke(3); g.gang_smoke(8)"; then
+    note "gang smoke $seed_base OK"
+  else
+    note "gang smoke $seed_base FAILED (gang result diverged from the single-chip reference or a rule table went unhealthy)"
     fail=1
   fi
   note "chaos matrix leg DMLC_CHAOS_SEED=$seed_base"
