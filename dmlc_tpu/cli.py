@@ -56,6 +56,21 @@ def format_latency(summary: dict[str, float]) -> str:
     )
 
 
+def _fmt_decision(d: dict) -> str:
+    """One autoscale decision on one line (status / tenants verbs)."""
+    move = (
+        f"{d.get('from_')}->{d.get('to')}"
+        if d.get("direction") in ("up", "down")
+        else f"at {d.get('at')} ({d.get('reason')})"
+    )
+    burn = d.get("burn")
+    extra = f" burn={burn:.1f}x" if isinstance(burn, (int, float)) else ""
+    return (
+        f"{d.get('direction')} {d.get('target')} {move} "
+        f"[{d.get('trigger')}]{extra}"
+    )
+
+
 def pop_option(args: list[str], name: str, cast=str):
     """Extract ``--name value`` from a REPL token list (mutates ``args``);
     None when absent, ValueError on a missing or uncastable value."""
@@ -101,12 +116,15 @@ Commands (reference: README.md:10-23):
   assign                                per-job member assignment table
   status                                overload-control counters: sheds,
                                         deadline trips, queue high-water,
-                                        breakers, gray-demoted members
+                                        breakers, gray-demoted members,
+                                        per-tenant gate occupancy + quota
+                                        debt, autoscaler last decision
   metrics [prom|fleet]                  this node's metric registry (counters,
                                         gauges, latency summaries); `prom` =
                                         Prometheus text; `fleet` = the leader's
                                         latest per-member scrape + tree-merged
-                                        totals (flags: --top K busiest nodes,
+                                        totals incl. per-gate quota sheds
+                                        (flags: --top K busiest nodes,
                                         --worst K most error-laden nodes)
   trace on|off|summary|export <path>    span tracing: toggle FLEET-WIDE,
                                         aggregate table, local Chrome trace
@@ -121,6 +139,10 @@ Commands (reference: README.md:10-23):
                                         lanes, --worst K slowest-p99 lanes)
   slo                                   per-model SLO burn rates + the current
                                         placement plan (leader's evaluator)
+  tenants                               tenant table: declared priorities and
+                                        shares, per-gate occupancy/quota/debt,
+                                        per-tenant burn lanes (leader's
+                                        evaluator), autoscaler decision ring
   device                                device-plane fleet table (devicemon):
                                         HBM used/limit, jit compiles +
                                         compile-seconds, steady-state
@@ -335,6 +357,12 @@ class Cli:
                     f"shed={g['sheds']} queue_hw={g['queue_hw']} "
                     f"(max_inflight={g['max_inflight']}, max_queue={g['max_queue']})"
                 )
+                for tname, t in sorted((g.get("tenants") or {}).items()):
+                    out.append(
+                        f"    tenant {tname}: {t['active']}/{t['quota']} "
+                        f"slots, debt={t['debt']}, priority={t['priority']}, "
+                        f"over_quota_sheds={t['over_quota_sheds']}"
+                    )
             for name, b in sorted(s.get("microbatch", {}).items()):
                 out.append(
                     f"  microbatch[{name}]: requests={b['requests']} "
@@ -345,6 +373,17 @@ class Cli:
                 out.append(
                     f"  breaker {dest}: {br['state']} (opens={br['opens']}, "
                     f"consec_failures={br['consec']})"
+                )
+            auto = s.get("autoscaler")
+            if auto:
+                targets = ", ".join(
+                    f"{name}={t['current']}"
+                    for name, t in sorted(auto.get("targets", {}).items())
+                )
+                last = auto.get("last_decision")
+                out.append(
+                    f"  autoscaler: {targets or '(no targets)'}; last: "
+                    + (_fmt_decision(last) if last else "(no decisions yet)")
                 )
             cluster = s.get("cluster")
             if cluster:
@@ -430,6 +469,16 @@ class Cli:
                         if v and not k.endswith("_high")
                     )
                     out += f"\nfleet totals (tree-merged): {totals or '(all zero)'}"
+                    quota = {
+                        k[len("shed_over_quota_"):]: v
+                        for k, v in sorted(merged.items())
+                        if k.startswith("shed_over_quota_") and v
+                    }
+                    if quota:
+                        out += (
+                            "\nquota sheds (typed over_quota, by gate): "
+                            + ", ".join(f"{k}={v}" for k, v in quota.items())
+                        )
                 stale = reply.get("stale") or []
                 if stale:
                     out += (
@@ -619,6 +668,76 @@ class Cli:
                 )
                 for name, ms in sorted(assignment.items()):
                     out.append(f"  {name}: {', '.join(ms)}")
+            return "\n".join(out)
+        if cmd == "tenants":
+            # The tenant plane in one read (docs/OPERATIONS.md §Tenants):
+            # declared table, this node's gate ledgers, the leader's
+            # per-tenant burn lanes, and the autoscaler's decision ring.
+            specs = n.tenant_specs
+            if not specs:
+                return (
+                    "no tenants declared (config.tenants): every caller "
+                    "rides the default tenant with the full share"
+                )
+            out = [format_table(
+                ["tenant", "priority", "share"],
+                [[name, sp.priority, f"{sp.share:.2f}"]
+                 for name, sp in sorted(specs.items())],
+            )]
+            for gate_name, gate in (
+                ("predict", n.predict_gate), ("transfer", n.transfer_gate),
+            ):
+                tenants = gate.summary().get("tenants") or {}
+                if not tenants:
+                    continue
+                out.append(f"{gate_name} gate (this node):")
+                out.append(format_table(
+                    ["tenant", "priority", "occupancy", "debt",
+                     "over-quota sheds"],
+                    [[tname, t["priority"], f"{t['active']}/{t['quota']}",
+                      t["debt"], t["over_quota_sheds"]]
+                     for tname, t in sorted(tenants.items())],
+                ))
+            try:
+                reply = n.rpc.call(n.tracker.current, "obs.slo", {}, timeout=5.0)
+            except Exception as e:
+                out.append(f"leader slo status unavailable: {e}")
+                reply = {}
+            lanes = []
+            for model, s in sorted(
+                ((reply.get("slo") or {}).get("models") or {}).items()
+            ):
+                for tname, lane in sorted((s.get("tenants") or {}).items()):
+                    p99 = lane.get("p99_s")
+                    lanes.append([
+                        f"{model}@{tname}",
+                        f"{p99 * 1e3:.1f}ms" if p99 is not None else "-",
+                        f"{lane['fast_burn']:.2f}x",
+                        f"{lane['slow_burn']:.2f}x",
+                        "FAST-BURN" if lane.get("fast_alert")
+                        else ("slow-burn" if lane.get("slow_alert") else "ok"),
+                    ])
+            if lanes:
+                out.append("per-tenant burn (leader's evaluator):")
+                out.append(format_table(
+                    ["lane", "p99", "fast burn", "slow burn", "state"], lanes,
+                ))
+            auto = reply.get("autoscaler") or (
+                n.autoscaler.status() if n.autoscaler is not None else {}
+            )
+            if auto:
+                targets = ", ".join(
+                    f"{name}={t['current']} (streak {t['clear_streak']}"
+                    f"/{auto['clear_windows']}w)"
+                    for name, t in sorted(auto.get("targets", {}).items())
+                )
+                out.append(f"autoscaler targets: {targets or '(none)'}")
+                decisions = auto.get("decisions") or []
+                out.append(
+                    "autoscaler decisions: "
+                    + ("; ".join(_fmt_decision(d) for d in decisions[-4:])
+                       if decisions else "(none yet)")
+                )
             return "\n".join(out)
         if cmd == "device":
             # Device-plane fleet table (cluster/devicemon.py, docs/

@@ -15,14 +15,25 @@ admitted completes inside its deadline (docs/OVERLOAD.md).
 (blocked on the backend's serialization); past that, ``admit`` raises
 ``Overloaded`` with the retry-after hint. Counters (sheds, admitted,
 queue-depth high-water) flow to utils/metrics.Counters and the tracer.
+
+Multi-tenant quotas (docs/OVERLOAD.md §Priority classes): with a tenant
+table configured (utils/config ``tenants``), each request's ambient
+tenant (cluster/tenant.py — frame field ``n``) is charged against that
+tenant's share of the gate's total capacity. A tenant at its quota sheds
+*typed* (``Overloaded.quota == "over_quota"``) even while the gate has
+room — so one workload's flash crowd exhausts only its own tokens and
+never the whole door — and a gate-full shed names the tenant too. With
+no tenants configured the gate is bit-identical to the single-tenant
+fleet.
 """
 
 from __future__ import annotations
 
 import threading
 from contextlib import contextmanager
-from typing import Iterator
+from typing import Iterator, Mapping
 
+from dmlc_tpu.cluster import tenant as tenant_mod
 from dmlc_tpu.cluster.rpc import Overloaded
 from dmlc_tpu.utils.metrics import Counters
 from dmlc_tpu.utils.tracing import tracer
@@ -40,6 +51,7 @@ class AdmissionGate:
         metrics: Counters | None = None,
         retry_after_s: float = 0.25,
         flight=None,
+        tenants: Mapping[str, tenant_mod.TenantSpec] | None = None,
     ):
         self.max_inflight = int(max_inflight)
         self.max_queue = max(0, int(max_queue))
@@ -54,34 +66,65 @@ class AdmissionGate:
         self.admitted = 0
         self.sheds = 0
         self.queue_hw = 0  # high-water of requests waiting beyond max_inflight
+        # Per-tenant occupancy vs share-derived quotas (cluster/tenant.py).
+        # Accounting always runs (the status plane wants occupancy even on
+        # a quota-less fleet); *enforcement* only when tenants are declared.
+        self.ledger = tenant_mod.TenantLedger(tenants, self.capacity)
 
     @property
     def capacity(self) -> int:
         return self.max_inflight + self.max_queue
 
+    def _shed(self, tenant: str, verdict: str) -> None:
+        """Count + flight-record one refusal, then raise it typed. Called
+        under the gate lock."""
+        self.sheds += 1
+        self.ledger.note_shed(tenant)
+        if self.metrics is not None:
+            self.metrics.inc("shed")
+            self.metrics.inc(f"shed_{self.name}")
+            if verdict == "over_quota":
+                self.metrics.inc(f"shed_over_quota_{self.name}")
+        tracer.record(f"overload/shed_{self.name}", 0.0)
+        if self.flight is not None:
+            self.flight.note(
+                "shed", gate=self.name, active=self.active,
+                tenant=tenant, quota=verdict,
+            )
+        if verdict == "over_quota":
+            msg = (
+                f"{self.name}: tenant {tenant!r} at quota "
+                f"({self.ledger.active(tenant)}/{self.ledger.quota(tenant)} tokens)"
+            )
+        else:
+            msg = (
+                f"{self.name}: {self.active} in flight / queue full "
+                f"(max_inflight={self.max_inflight}, max_queue={self.max_queue})"
+            )
+        raise Overloaded(
+            msg, retry_after_s=self.retry_after_s, tenant=tenant, quota=verdict
+        )
+
     @contextmanager
     def admit(self) -> Iterator[None]:
         """Hold one admission slot for the duration of the request; raise
-        ``Overloaded`` (with the retry-after hint) when the gate is full."""
+        ``Overloaded`` (with the retry-after hint and the tenant + quota
+        verdict) when the gate — or the calling tenant's quota — is full."""
         if self.max_inflight <= 0:
             yield
             return
+        tenant = tenant_mod.current()
         with self._lock:
+            # Quota first: "it's you" is the more actionable verdict, and
+            # checking it before the global bound is what guarantees a
+            # surging tenant sheds against its own share, not the door.
+            if self.ledger.would_exceed(tenant):
+                self._shed(tenant, "over_quota")
             if self.active >= self.capacity:
-                self.sheds += 1
-                if self.metrics is not None:
-                    self.metrics.inc("shed")
-                    self.metrics.inc(f"shed_{self.name}")
-                tracer.record(f"overload/shed_{self.name}", 0.0)
-                if self.flight is not None:
-                    self.flight.note("shed", gate=self.name, active=self.active)
-                raise Overloaded(
-                    f"{self.name}: {self.active} in flight / queue full "
-                    f"(max_inflight={self.max_inflight}, max_queue={self.max_queue})",
-                    retry_after_s=self.retry_after_s,
-                )
+                self._shed(tenant, "gate_full")
             self.active += 1
             self.admitted += 1
+            self.ledger.acquire(tenant)
             waiting = self.active - self.max_inflight
             if waiting > self.queue_hw:
                 self.queue_hw = waiting
@@ -92,10 +135,11 @@ class AdmissionGate:
         finally:
             with self._lock:
                 self.active -= 1
+                self.ledger.release(tenant)
 
     def summary(self) -> dict:
         with self._lock:
-            return {
+            out: dict = {
                 "max_inflight": self.max_inflight,
                 "max_queue": self.max_queue,
                 "active": self.active,
@@ -103,3 +147,7 @@ class AdmissionGate:
                 "sheds": self.sheds,
                 "queue_hw": self.queue_hw,
             }
+            tenants = self.ledger.summary()
+            if tenants:
+                out["tenants"] = tenants
+            return out

@@ -91,6 +91,12 @@ class DecodeTierClient:
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(1, int(fanout)), thread_name_prefix="decode-tier"
         )
+        # Effective fan-out: how many chunks a batch shards into. The pool
+        # above is the hard ceiling (built once — lint H1); the autoscaler
+        # moves this bound inside [1, ceiling] to trade ingest parallelism
+        # against RPC pressure on a burning fleet.
+        self.max_fanout = max(1, int(fanout))
+        self._fanout = self.max_fanout
         self._lock = threading.Lock()
         # Tier accounting (decode_tier_* counters mirror into ``metrics``).
         self.remote_decoded = 0   # images decoded by a peer
@@ -99,6 +105,20 @@ class DecodeTierClient:
         self.remote_failures = 0  # chunk attempts lost to transport errors
         self._busy_s = 0.0        # decode_batch wall seconds
         self._images = 0          # images through decode_batch
+
+    # ---- autoscaler seam -------------------------------------------------
+
+    def set_fanout(self, fanout: int) -> int:
+        """Bound concurrent chunk fan-out to ``fanout``, clamped to
+        [1, construction-time pool width]. Returns the effective value —
+        the actuator records what actually took, not what it asked for."""
+        with self._lock:
+            self._fanout = max(1, min(self.max_fanout, int(fanout)))
+            return self._fanout
+
+    def fanout(self) -> int:
+        with self._lock:
+            return self._fanout
 
     # ---- stats ----------------------------------------------------------
 
@@ -114,6 +134,7 @@ class DecodeTierClient:
                 "poison": self.poison,
                 "remote_failures": self.remote_failures,
                 "fleet_decode_img_s": round(rate, 1) if rate else None,
+                "fanout": self._fanout,
             }
 
     # ---- decode entry points --------------------------------------------
@@ -142,7 +163,7 @@ class DecodeTierClient:
         if n < self.min_batch or not peers:
             self._decode_local(list(blobs), 0, out, size)
         else:
-            chunks = self._chunks(blobs, len(peers))
+            chunks = self._chunks(blobs, min(len(peers), self.fanout()))
             with tracer.span("ingest/decode_tier", n=n, chunks=len(chunks)):
                 futs = [
                     self._pool.submit(

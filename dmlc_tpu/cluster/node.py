@@ -43,7 +43,9 @@ from dmlc_tpu.cluster.retrypolicy import RetryPolicy
 from dmlc_tpu.cluster.rpc import TcpRpc, TcpRpcServer
 from dmlc_tpu.cluster.scrapetree import ScrapeDelegate, ScrapeTreeCoordinator
 from dmlc_tpu.cluster.sdfs import MemberStore, SdfsClient, SdfsLeader, SdfsMember
+from dmlc_tpu.cluster.tenant import parse_tenants
 from dmlc_tpu.cluster.transport import UdpTransport
+from dmlc_tpu.scheduler.autoscaler import Autoscaler, ScaleTarget
 from dmlc_tpu.scheduler.jobs import JobScheduler
 from dmlc_tpu.scheduler.placement import PlacementAdvisor, SloEvaluator, SloObjective
 from dmlc_tpu.scheduler.worker import (
@@ -56,7 +58,7 @@ from dmlc_tpu.scheduler.worker import (
 )
 from dmlc_tpu.utils import compile_cache, tracing
 from dmlc_tpu.utils.config import ClusterConfig
-from dmlc_tpu.utils.metrics import Counters, Registry
+from dmlc_tpu.utils.metrics import Counters, Registry, TenantLabelGuard
 from dmlc_tpu.utils.tracing import traced_methods
 
 log = logging.getLogger(__name__)
@@ -156,6 +158,15 @@ class ClusterNode:
         # verbs, leader.status, and the obs.* scrape surface all read the
         # same numbers the gates/breakers/scheduler write.
         self.metrics = Counters()
+        # Multi-tenant admission (cluster/tenant.py, docs/OVERLOAD.md
+        # §Priority classes): the declared tenant table feeds every gate's
+        # quota ledger, the SLO evaluator's per-tenant lanes, and the CLI
+        # `tenants` verb; the label guard bounds per-tenant metric
+        # cardinality fleet-wide (one guard per node, shared).
+        self.tenant_specs = parse_tenants(config.tenants)
+        self.tenant_guard = TenantLabelGuard(
+            config.metrics_max_tenants, counters=self.metrics
+        )
         self.lane = f"{config.host}:{config.member_port}"
         self.flight = FlightRecorder(
             clock=self.clock.monotonic, node=self.lane
@@ -177,6 +188,7 @@ class ClusterNode:
             metrics=self.metrics,
             retry_after_s=config.shed_retry_after_s,
             flight=self.flight,
+            tenants=self.tenant_specs,
         )
         self.transfer_gate = AdmissionGate(
             config.transfer_max_inflight,
@@ -185,6 +197,7 @@ class ClusterNode:
             metrics=self.metrics,
             retry_after_s=config.shed_retry_after_s,
             flight=self.flight,
+            tenants=self.tenant_specs,
         )
         self.registry.gauge("predict_gate_active", lambda: self.predict_gate.active)
         self.registry.gauge("transfer_gate_active", lambda: self.transfer_gate.active)
@@ -338,6 +351,7 @@ class ClusterNode:
                         m, self.lane, "gen/step", sec
                     ),
                     device_work=self.devicemon.device_work,
+                    tenants=self.tenant_specs,
                 )
                 for name in config.generate_models
             }
@@ -403,6 +417,7 @@ class ClusterNode:
         self.advisor = None
         self.slo = None
         self.scrapetree = None
+        self.autoscaler = None
         if self.is_candidate:
             self._start_leader_services()
 
@@ -479,12 +494,96 @@ class ClusterNode:
                     max_queue=config.predict_max_queue,
                     metrics=self.metrics,
                     flight=self.flight,
+                    tenants=self.tenant_specs,
                 )
                 self.worker.backends[name] = wrapped
                 self._batchers.append(wrapped)
                 self.registry.gauge(
                     f"microbatch_queue_{name}", lambda b=wrapped: len(b._queue)
                 )
+
+        # --- elastic autoscaler (scheduler/autoscaler.py, ISSUE 18) -----
+        # Built LAST: its scale targets hold the decode tier, the generate
+        # backends, and (on a leader candidate) the placement advisor, all
+        # wired above. Ticked from the leader's obs scrape loop right after
+        # the SLO evaluation it keys off — a non-leading node registers its
+        # local seams but never ticks.
+        if config.autoscaler_enabled:
+            self.autoscaler = Autoscaler(
+                flight=self.flight,
+                metrics=self.metrics,
+                clock=self.clock.monotonic,
+                clear_windows=config.autoscaler_clear_windows,
+                moves_budget=config.autoscaler_moves_budget,
+                hbm_ceiling=config.autoscaler_hbm_ceiling,
+                hbm_used=self._fleet_hbm_used,
+            )
+            if self.decode_tier is not None:
+                self.autoscaler.register(ScaleTarget(
+                    "decode_fanout",
+                    get=self.decode_tier.fanout,
+                    apply=self.decode_tier.set_fanout,
+                    lo=1,
+                    hi=self.decode_tier.max_fanout,
+                ))
+            for name, gb in self._gen_backends.items():
+                self.autoscaler.register(ScaleTarget(
+                    f"gen_slots_{name}",
+                    get=gb.slot_limit,
+                    apply=gb.set_slot_limit,
+                    lo=1,
+                    hi=gb.max_slots,
+                    models={name},
+                    memory_bound=True,  # slots pin KV pages in HBM
+                ))
+            if self.advisor is not None:
+                for name in self.config.job_models:
+                    self.autoscaler.register(ScaleTarget(
+                        f"replicas_{name}",
+                        get=lambda n=name: self._replica_current(n),
+                        apply=lambda v, n=name: self._apply_replica_target(n, v),
+                        lo=config.autoscaler_min_replicas,
+                        hi=config.autoscaler_max_replicas,
+                        models={name},
+                    ))
+
+    def _replica_current(self, name: str) -> int:
+        """Autoscaler read seam for per-model replica counts: the explicit
+        target once one is set, else the advisor's live assignment width
+        (gang width counts — a gang is one multi-chip replica set)."""
+        adv = self.advisor
+        if adv is None:
+            return self.config.autoscaler_min_replicas
+        target = adv.replica_targets.get(name)
+        if target is not None:
+            return target
+        assigned = adv.status()["assignment"].get(name)
+        return len(assigned) if assigned else self.config.autoscaler_min_replicas
+
+    def _apply_replica_target(self, name: str, value: int) -> int:
+        """Autoscaler apply seam: pin the advisor's replica target and ask
+        the scheduler to replan now — a shrink marks the cached plan stale,
+        a growth raises the dealing cap (and widens gangs)."""
+        if self.advisor is None:
+            return value
+        self.advisor.set_replica_target(name, value)
+        if self.scheduler is not None:
+            self.scheduler.request_replan(f"autoscale:{name}")
+        return value
+
+    def _fleet_hbm_used(self) -> float | None:
+        """Worst-device HBM occupancy fraction across the last fleet scrape
+        (the autoscaler's scale-up guard). None while the device plane is
+        dark — unknown never blocks."""
+        worst = None
+        for reply in self.fleet_metrics.values():
+            gauges = (reply.get("metrics") or {}).get("gauges", {})
+            limit = gauges.get("hbm_limit_bytes")
+            used = gauges.get("hbm_bytes_in_use")
+            if limit and used is not None and float(limit) > 0:
+                frac = float(used) / float(limit)
+                worst = frac if worst is None else max(worst, frac)
+        return worst
 
     # ---- leader side ---------------------------------------------------
 
@@ -590,6 +689,11 @@ class ClusterNode:
                 on_fast_burn=lambda model: self.scheduler.request_replan(
                     f"slo_fast_burn:{model}"
                 ),
+                # Per-tenant burn lanes (ISSUE 18): each declared tenant's
+                # traffic is scored against the model objective on its own
+                # ``model@tenant`` profiler lane.
+                tenants=sorted(self.tenant_specs),
+                tenant_guard=self.tenant_guard,
             )
         # Delegated scrape tree (cluster/scrapetree.py): past
         # scrape_tree_min_members the scrape loop partitions the ring and
@@ -623,6 +727,10 @@ class ClusterNode:
                     "slo": self.slo.status() if self.slo is not None else {},
                     "placement": (
                         self.advisor.status() if self.advisor is not None else {}
+                    ),
+                    "autoscaler": (
+                        self.autoscaler.status()
+                        if self.autoscaler is not None else {}
                     ),
                 },
             }),
@@ -1035,6 +1143,15 @@ class ClusterNode:
                 self.profiler.ingest_scrape(addr, reply)
             if self.slo is not None:
                 state = self.slo.evaluate()
+                if self.autoscaler is not None:
+                    # Close the elastic loop on the same cadence the burn
+                    # verdicts refresh: burning lanes (including per-tenant
+                    # composites) drive scale-up, quiet streaks scale-down.
+                    self.autoscaler.tick(
+                        self.slo.burning_models(),
+                        {lane: st.get("fast", 0.0)
+                         for lane, st in state.items()},
+                    )
                 if cfg.trace_burn_force_sample_s > 0:
                     burning = [m for m, st in sorted(state.items())
                                if st.get("fast_alert")]
@@ -1226,6 +1343,13 @@ class ClusterNode:
             "breakers": self.retry_policy.snapshot(),
             "flight_recorded": self.flight.to_wire()["recorded"],
         }
+        if self.tenant_specs:
+            out["tenants"] = {
+                name: {"priority": spec.priority, "share": spec.share}
+                for name, spec in sorted(self.tenant_specs.items())
+            }
+        if self.autoscaler is not None:
+            out["autoscaler"] = self.autoscaler.status()
         if self._batchers:
             out["microbatch"] = {
                 name: b.summary()

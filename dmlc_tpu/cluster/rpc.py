@@ -40,6 +40,7 @@ from typing import Callable
 import msgpack
 
 from dmlc_tpu.cluster import deadline as deadline_mod
+from dmlc_tpu.cluster import tenant as tenant_mod
 from dmlc_tpu.cluster import tracectx
 from dmlc_tpu.cluster.auth import AuthError, FrameAuth
 from dmlc_tpu.utils import tracing
@@ -107,11 +108,26 @@ class DeadlineExceeded(RpcError):
 class Overloaded(RpcError):
     """The destination shed the request at admission (queue full). Carries a
     retry-after hint; message always carries ``overloaded:`` so the verdict
-    survives the wire."""
+    survives the wire.
 
-    def __init__(self, msg: str, retry_after_s: float | None = None):
+    ``tenant`` + ``quota`` carry the admission verdict for multi-tenant
+    gates (docs/OVERLOAD.md §Priority classes): which tenant was refused
+    and why — ``"over_quota"`` (the tenant exhausted its own share; peers
+    still have room) vs ``"gate_full"`` (the whole resource is saturated).
+    Both survive the wire as dedicated reply fields, so a client can tell
+    "slow down, it's you" from "the fleet is drowning"."""
+
+    def __init__(
+        self,
+        msg: str,
+        retry_after_s: float | None = None,
+        tenant: str | None = None,
+        quota: str | None = None,
+    ):
         super().__init__(msg if "overloaded:" in msg else f"overloaded: {msg}")
         self.retry_after_s = retry_after_s
+        self.tenant = tenant
+        self.quota = quota
 
 
 class DecodeError(RpcError):
@@ -127,14 +143,20 @@ class DecodeError(RpcError):
         super().__init__(msg if "decode_error:" in msg else f"decode_error: {msg}")
 
 
-def remote_error(msg: str, retry_after_s: float | None = None) -> RpcError:
+def remote_error(
+    msg: str,
+    retry_after_s: float | None = None,
+    tenant: str | None = None,
+    quota: str | None = None,
+) -> RpcError:
     """Re-type a remote error string: the server flattened the exception to
     ``ClassName: message``; the prefixes put the type back so client-side
-    retry policy keys on it."""
+    retry policy keys on it. The tenant/quota verdict fields (when the
+    remote gate supplied them) re-attach to the rebuilt ``Overloaded``."""
     if "deadline:" in msg:
         return DeadlineExceeded(msg)
     if "overloaded:" in msg:
-        return Overloaded(msg, retry_after_s=retry_after_s)
+        return Overloaded(msg, retry_after_s=retry_after_s, tenant=tenant, quota=quota)
     if "decode_error:" in msg:
         return DecodeError(msg)
     return RpcError(msg)
@@ -179,6 +201,7 @@ def serve_with_deadline(
     clock: Callable[[], float],
     trace=None,
     lane: str | None = None,
+    tenant=None,
 ) -> dict:
     """Server-side dispatch under the caller's propagated budget: refuse
     work that arrives already expired, bind the deadline ambiently so
@@ -189,9 +212,13 @@ def serve_with_deadline(
     ``trace`` is the frame's ``t`` field (cluster/tracectx.py): it is bound
     ambiently — INCLUDING the None case, which clears any context inherited
     on the caller's stack, so the sim fabric propagates exactly what the
-    wire carries and nothing more. ``lane`` is the serving node's identity,
-    bound so every span the handler opens attributes to this node."""
-    with tracing.lane(lane), tracectx.bind(tracectx.from_wire(trace)):
+    wire carries and nothing more. ``tenant`` is the frame's ``n`` field
+    (cluster/tenant.py), bound identically — an absent field clears to the
+    default tenant, so legacy callers on a mixed-version fleet keep their
+    pre-tenancy standing. ``lane`` is the serving node's identity, bound so
+    every span the handler opens attributes to this node."""
+    with tracing.lane(lane), tracectx.bind(tracectx.from_wire(trace)), \
+            tenant_mod.bind(tenant_mod.from_wire(tenant)):
         if budget_s is None:
             return _dispatch(methods, method, payload)
         budget_s = float(budget_s)
@@ -228,7 +255,7 @@ class SimRpcNetwork(Rpc):
         self.down: set[str] = set()
         self.cut: set[tuple[str, str]] = set()
         self.calls: list[tuple[str, str]] = []  # (addr, method) trace for tests
-        # Frame METADATA per call ({"m", "d"} + "t" when present — payload
+        # Frame METADATA per call ({"m", "d"} + "t"/"n" when present — payload
         # deliberately excluded so soak tests don't pin every transferred
         # blob in memory), for tests that assert on the wire format.
         self.frames: list[dict] = []
@@ -313,6 +340,9 @@ class SimRpcNetwork(Rpc):
         t = tracectx.wire_context()
         if t is not None:
             frame["t"] = t
+        n = tenant_mod.wire_context()
+        if n is not None:
+            frame["n"] = n
         self.frames.append(frame)
         action = MC_DELIVER
         if self.mc_hook is not None:
@@ -330,6 +360,7 @@ class SimRpcNetwork(Rpc):
                 return serve_with_deadline(
                     self.services[addr], method, payload, budget - lat,
                     clock=self.clock, trace=frame.get("t"), lane=addr,
+                    tenant=frame.get("n"),
                 )
             except RpcError:
                 raise
@@ -490,13 +521,19 @@ class TcpRpcServer:
                         reply = serve_with_deadline(
                             self.methods, req["m"], req["p"], req.get("d"),
                             clock=_now, trace=req.get("t"), lane=self.lane,
+                            tenant=req.get("n"),
                         )
                         _send_frame(conn, {"ok": True, "r": reply}, self.auth, recipient=peer)
                     except Exception as e:  # method error -> remote RpcError
                         self._count(e)
                         err: dict = {"ok": False, "e": f"{type(e).__name__}: {e}"}
-                        if isinstance(e, Overloaded) and e.retry_after_s is not None:
-                            err["retry_after"] = float(e.retry_after_s)
+                        if isinstance(e, Overloaded):
+                            if e.retry_after_s is not None:
+                                err["retry_after"] = float(e.retry_after_s)
+                            if e.tenant is not None:
+                                err["tenant"] = str(e.tenant)
+                            if e.quota is not None:
+                                err["quota"] = str(e.quota)
                         _send_frame(conn, err, self.auth, recipient=peer)
             except (RpcUnreachable, OSError):
                 return  # client went away
@@ -573,6 +610,9 @@ class TcpRpc(Rpc):
                 t = tracectx.wire_context()
                 if t is not None:
                     req["t"] = t
+                n = tenant_mod.wire_context()
+                if n is not None:
+                    req["n"] = n
                 _send_frame(sock, req, self.auth, recipient=addr)
                 left = remaining()
                 if left <= 0:
@@ -589,6 +629,9 @@ class TcpRpc(Rpc):
             raise RpcUnreachable(f"{addr}: {e}") from e
         if not reply.get("ok"):
             raise remote_error(
-                reply.get("e", "remote error"), retry_after_s=reply.get("retry_after")
+                reply.get("e", "remote error"),
+                retry_after_s=reply.get("retry_after"),
+                tenant=reply.get("tenant"),
+                quota=reply.get("quota"),
             )
         return reply["r"]

@@ -47,6 +47,7 @@ from time import monotonic
 from typing import Any, NoReturn
 
 from dmlc_tpu.cluster import deadline as deadline_mod
+from dmlc_tpu.cluster import tenant as tenant_mod
 from dmlc_tpu.cluster import tracectx
 from dmlc_tpu.cluster.rpc import Overloaded
 from dmlc_tpu.generate.kvcache import PagePoolExhausted
@@ -145,12 +146,13 @@ class _Slot:
     __slots__ = (
         "stream", "prompt", "max_new_tokens", "temperature", "eos_id",
         "deadline", "trace_ctx", "pages", "emitted", "slot", "submitted_t",
+        "tenant",
     )
 
     def __init__(self, stream: GenStream, prompt: list[int],
                  max_new_tokens: int, temperature: float, eos_id: int | None,
                  deadline: Any, trace_ctx: Any, pages: list[int],
-                 submitted_t: float) -> None:
+                 submitted_t: float, tenant: str) -> None:
         self.stream = stream
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -162,6 +164,7 @@ class _Slot:
         self.emitted = 0
         self.slot = -1
         self.submitted_t = submitted_t
+        self.tenant = tenant
 
 
 class SlotScheduler:
@@ -182,6 +185,7 @@ class SlotScheduler:
         autostart: bool = True,
         lane: Any = None,
         profile: Callable[[float], None] | None = None,
+        tenants: Any = None,
     ) -> None:
         self.engine = engine
         self.name = name
@@ -200,6 +204,21 @@ class SlotScheduler:
         # Bounded join queue beyond the slot table itself: 0 = no waiting,
         # a submit either takes a slot-table place or sheds.
         self.max_waiting = max(0, int(max_waiting))
+        # Per-tenant quotas over the in-flight bound (cluster/tenant.py):
+        # a tenant's share of (slot table + wait queue), enforced at
+        # submit; eviction ordering below prefers low-priority-and-over-
+        # quota residents. No tenants declared = legacy behavior.
+        self.ledger = tenant_mod.TenantLedger(
+            tenants, int(engine.max_slots) + self.max_waiting
+        )
+        # Autoscaler-adjustable soft bounds (scheduler/autoscaler.py):
+        # max_active caps ADMITTED slots at <= the compiled slot table;
+        # page_budget caps pages-in-use at <= the allocated pool (0 = the
+        # pool itself). Both resize live — the compiled step shape and the
+        # HBM pool never change, only how much of them admission hands out.
+        self.max_active = int(engine.max_slots)
+        self.page_budget = 0
+        self._page_total = int(getattr(engine, "pages_free", 0))
         self._cv = threading.Condition()
         self._pending: list[_Slot] = []
         self._closed = False
@@ -263,38 +282,83 @@ class SlotScheduler:
             )
         if deadline is None:
             deadline = deadline_mod.current()
+        tenant = tenant_mod.current()
         stream = GenStream(request_id or os.urandom(6).hex())
         with self._cv:
             if self._closed:
                 raise RuntimeError("slot scheduler is stopped")
+            if self.ledger.would_exceed(tenant):
+                self._shed(
+                    f"tenant {tenant!r} at quota "
+                    f"({self.ledger.active(tenant)}/{self.ledger.quota(tenant)})",
+                    tenant=tenant, verdict="over_quota",
+                )
             in_flight = len(self._resident) + len(self._pending)
-            if in_flight >= self.engine.max_slots + self.max_waiting:
-                self._shed(f"slot table full ({in_flight} in flight)")
+            limit = min(int(self.engine.max_slots), self.max_active) + self.max_waiting
+            if in_flight >= limit:
+                self._shed(f"slot table full ({in_flight} in flight)",
+                           tenant=tenant)
+            if self.page_budget > 0 and \
+                    self._page_total - self.engine.pages_free >= self.page_budget:
+                self._shed(
+                    f"page budget exhausted "
+                    f"({self._page_total - self.engine.pages_free}/"
+                    f"{self.page_budget} pages in use)",
+                    tenant=tenant,
+                )
             try:
                 pages = self.engine.reserve(len(prompt))
             except PagePoolExhausted as e:
-                self._shed(f"page pool exhausted: {e}")
+                self._shed(f"page pool exhausted: {e}", tenant=tenant)
             self.requests += 1
             if self.metrics is not None:
                 self.metrics.inc("gen_requests")
             slot = _Slot(
                 stream, prompt, int(max_new_tokens), float(temperature),
                 eos_id, deadline, tracectx.current(), pages, self.clock(),
+                tenant,
             )
             self._pending.append(slot)
+            self.ledger.acquire(tenant)
             self._cv.notify_all()
         return stream
 
-    def _shed(self, why: str) -> NoReturn:
+    def _shed(self, why: str, tenant: str | None = None,
+              verdict: str = "gate_full") -> NoReturn:
         self.sheds += 1
+        if tenant is not None:
+            self.ledger.note_shed(tenant)
         if self.metrics is not None:
             self.metrics.inc("shed")
             self.metrics.inc(f"shed_{self.name}")
+            if verdict == "over_quota":
+                self.metrics.inc(f"shed_over_quota_{self.name}")
         tracer.record(f"overload/shed_{self.name}", 0.0)
         if self.flight is not None:
             self.flight.note("shed", gate=self.name,
-                             active=len(self._resident))
-        raise Overloaded(f"{self.name}: {why}", retry_after_s=self.retry_after_s)
+                             active=len(self._resident), tenant=tenant,
+                             quota=verdict)
+        raise Overloaded(f"{self.name}: {why}",
+                         retry_after_s=self.retry_after_s,
+                         tenant=tenant, quota=verdict)
+
+    def set_limits(self, max_active: int | None = None,
+                   page_budget: int | None = None) -> dict[str, int]:
+        """Autoscaler actuation seam: resize the admitted share of the
+        slot table / page pool. Clamped to the compiled/allocated sizes —
+        the engine itself never reshapes. Returns the effective limits."""
+        with self._cv:
+            if max_active is not None:
+                self.max_active = max(1, min(int(max_active),
+                                             int(self.engine.max_slots)))
+            if page_budget is not None:
+                pb = int(page_budget)
+                if pb <= 0 or (self._page_total and pb >= self._page_total):
+                    self.page_budget = 0
+                else:
+                    self.page_budget = max(1, pb)
+            return {"max_active": self.max_active,
+                    "page_budget": self.page_budget}
 
     # ---- decode loop -----------------------------------------------------
 
@@ -316,9 +380,11 @@ class SlotScheduler:
             if drained is not None:
                 for s in drained:
                     self.engine.release_reservation(s.pages)
+                    self._ledger_release(s)
                     s.stream.finish("overloaded: scheduler stopped")
                 for s in self._resident:
                     self.engine.release(s.slot)
+                    self._ledger_release(s)
                     s.stream.finish("overloaded: scheduler stopped")
                 self._resident = []
                 return
@@ -354,6 +420,7 @@ class SlotScheduler:
                 # Expired while waiting: a prefill now would be dead work.
                 self._unpend(req)
                 self.engine.release_reservation(req.pages)
+                self._ledger_release(req)
                 req.stream.finish("deadline: expired before a slot freed")
                 continue
             req.slot = free[0]
@@ -376,6 +443,7 @@ class SlotScheduler:
                         and not self.engine.cache.slot_pages(req.slot)):
                     self.engine.release_reservation(req.pages)
                 self.engine.release(req.slot)
+                self._ledger_release(req)
                 req.stream.finish(f"{type(e).__name__}: {e}")
                 continue
             req.pages = []  # ownership moved to the cache's slot binding
@@ -401,10 +469,46 @@ class SlotScheduler:
             if req in self._pending:
                 self._pending.remove(req)
 
+    def _ledger_release(self, req: _Slot) -> None:
+        with self._cv:
+            self.ledger.release(req.tenant)
+
+    def _eviction_victim(self, req: _Slot) -> _Slot:
+        """Eviction ordering (docs/OVERLOAD.md §Priority classes): when
+        ``req`` needs a page the pool cannot grant, the slot that dies is
+        the newest LOW-PRIORITY-AND-OVER-QUOTA resident — the workload
+        holding more than its share pays for the pressure it created.
+        With no such victim (everyone within quota, or ``req`` itself is
+        the over-quota low-priority one) the requester is evicted, as
+        before: within-quota work of another tenant is NEVER the victim."""
+        with self._cv:
+            spec = self.ledger.spec(req.tenant)
+            if spec.high_priority and not self.ledger.over_quota(req.tenant):
+                for other in reversed(self._resident):
+                    if other is req:
+                        continue
+                    if self.ledger.over_quota(other.tenant) and \
+                            not self.ledger.spec(other.tenant).high_priority:
+                        return other
+            return req
+
+    def _evict(self, victim: _Slot, why: Exception) -> None:
+        self.evictions += 1
+        if self.metrics is not None:
+            self.metrics.inc("gen_evictions")
+        if self.flight is not None:
+            self.flight.note("slot_evict", slot=victim.slot,
+                             emitted=victim.emitted, tenant=victim.tenant)
+        self._exit(victim, "evicted",
+                   error=f"overloaded: evicted mid-decode ({why})",
+                   counted=False)
+
     def _retire_and_step(self) -> None:
         # Between-step housekeeping: expired deadlines out, page growth
         # secured, THEN one fixed-shape step for whoever remains.
         for req in list(self._resident):
+            if req not in self._resident:
+                continue  # already evicted as another slot's page victim
             if req.deadline is not None and req.deadline.expired():
                 self._exit(req, "deadline",
                            error="deadline: generation exceeded its budget")
@@ -415,15 +519,15 @@ class SlotScheduler:
             try:
                 self.engine.ensure_capacity(req.slot)
             except PagePoolExhausted as e:
-                self.evictions += 1
-                if self.metrics is not None:
-                    self.metrics.inc("gen_evictions")
-                if self.flight is not None:
-                    self.flight.note("slot_evict", slot=req.slot,
-                                     emitted=req.emitted)
-                self._exit(req, "evicted",
-                           error=f"overloaded: evicted mid-decode ({e})",
-                           counted=False)
+                victim = self._eviction_victim(req)
+                self._evict(victim, e)
+                if victim is not req:
+                    # The freed pages may now cover the requester; if the
+                    # pool STILL cannot grant, the requester exits too.
+                    try:
+                        self.engine.ensure_capacity(req.slot)
+                    except PagePoolExhausted as e2:
+                        self._evict(req, e2)
         if not self._resident:
             return
         oldest = min(self._resident, key=lambda r: r.submitted_t)
@@ -457,6 +561,7 @@ class SlotScheduler:
         freed = self.engine.release(req.slot)
         with self._cv:  # submit reads len(_resident) for admission
             self._resident.remove(req)
+            self.ledger.release(req.tenant)
         if counted:
             self.completions += 1
         if self.flight is not None:
@@ -477,6 +582,8 @@ class SlotScheduler:
         return self.tokens_streamed / dt
 
     def summary(self) -> dict[str, Any]:
+        with self._cv:
+            tenants = self.ledger.summary()
         return {
             "requests": self.requests,
             "sheds": self.sheds,
@@ -486,6 +593,9 @@ class SlotScheduler:
             "tok_s": round(self.tok_s(), 2),
             "slots_active": self.engine.slots_active,
             "pages_free": self.engine.pages_free,
+            "max_active": self.max_active,
+            "page_budget": self.page_budget,
+            **({"tenants": tenants} if tenants else {}),
             "steps": self.engine.steps,
             "step_ms_p50": round(self.step_stats.percentile(50) * 1e3, 3)
             if len(self.step_stats) else None,

@@ -62,8 +62,10 @@ class GenerationBackend:
         lane: Any = None,
         profile: Callable[[float], None] | None = None,
         device_work: Any = None,
+        tenants: Any = None,
     ) -> None:
         self.model_name = model_name
+        self.tenants = tenants
         self.max_slots = int(max_slots)
         self.page_size = int(page_size)
         self.num_pages = int(num_pages)
@@ -113,8 +115,26 @@ class GenerationBackend:
                     registry=self.registry,
                     lane=self.lane,
                     profile=self.profile,
+                    tenants=self.tenants,
                 )
             return self._scheduler
+
+    def slot_limit(self) -> int:
+        """Autoscaler read seam: the effective slot-table bound (configured
+        width until the lazy engine builds)."""
+        with self._lock:
+            sched = self._scheduler
+        return sched.max_active if sched is not None else self.max_slots
+
+    def set_slot_limit(self, max_active: int) -> int:
+        """Autoscaler apply seam: bound the live slot table. A backend that
+        hasn't built yet just reports its configured width — there is no
+        running decode batch to bound."""
+        with self._lock:
+            sched = self._scheduler
+        if sched is None:
+            return self.max_slots
+        return int(sched.set_limits(max_active=max_active)["max_active"])
 
     def submit(self, prompt: Iterable[int], **kw: Any) -> GenStream:
         return self._ensure().submit(prompt, **kw)

@@ -39,9 +39,10 @@ import math
 import random
 import time
 from dataclasses import dataclass, field
-from typing import Iterator
+from typing import Any, Iterator
 
-from dmlc_tpu.cluster import observe, tracectx
+from dmlc_tpu.cluster import observe, tenant as tenant_mod, tracectx
+from dmlc_tpu.cluster.flight import FlightRecorder
 from dmlc_tpu.cluster.profile import CostProfiler
 from dmlc_tpu.cluster.rpc import (
     DeadlineExceeded,
@@ -51,7 +52,8 @@ from dmlc_tpu.cluster.rpc import (
     SimRpcNetwork,
 )
 from dmlc_tpu.cluster.scrapetree import ScrapeDelegate, ScrapeTreeCoordinator
-from dmlc_tpu.scheduler.placement import SloEvaluator, SloObjective
+from dmlc_tpu.scheduler.autoscaler import Autoscaler, ScaleTarget
+from dmlc_tpu.scheduler.placement import SloEvaluator, SloObjective, tenant_lane
 from dmlc_tpu.utils import tracing
 from dmlc_tpu.utils.metrics import Registry
 from dmlc_tpu.utils.tracing import traced_methods
@@ -74,23 +76,33 @@ KIND_SERVICE_S = {"predict": 0.08, "generate": 0.45}
 @dataclass(frozen=True)
 class TrafficMix:
     """One slice of the offered traffic: a model served by one kind of
-    request, drawn with probability proportional to ``weight``."""
+    request, drawn with probability proportional to ``weight``, on behalf
+    of ``tenant`` (cluster/tenant.py; the default tenant is the legacy
+    single-tenant traffic, byte-identical on the wire)."""
 
     model: str
     kind: str  # "predict" | "generate"
     weight: float = 1.0
+    tenant: str = tenant_mod.DEFAULT_TENANT
 
 
 @dataclass(frozen=True)
 class FlashCrowd:
     """A scripted step burst: rate multiplies by ``multiplier`` for
-    ``duration_s`` starting at ``start_s`` (overlapping crowds stack)."""
+    ``duration_s`` starting at ``start_s`` (overlapping crowds stack).
+    A crowd scoped to ``tenant`` multiplies ONLY that tenant's mixes —
+    the tenant-isolation certification drives exactly this: tenant A
+    surges 10x while tenant B's offered load never moves."""
 
     start_s: float
     duration_s: float
     multiplier: float
+    tenant: str | None = None
 
-    def factor_at(self, t: float) -> float:
+    def factor_at(self, t: float, tenant: str | None = None) -> float:
+        if self.tenant is not None and tenant is not None \
+                and tenant != self.tenant:
+            return 1.0
         return self.multiplier if self.start_s <= t < self.start_s + self.duration_s else 1.0
 
 
@@ -106,16 +118,30 @@ class TrafficSpec:
     flash_crowds: tuple[FlashCrowd, ...] = ()
     seed: int = 0
 
+    def _diurnal_at(self, t: float) -> float:
+        if self.diurnal_amplitude <= 0.0:
+            return 1.0
+        return 1.0 + self.diurnal_amplitude * math.sin(
+            2.0 * math.pi * t / self.diurnal_period_s
+        )
+
+    def mix_rates_at(self, t: float) -> list[float]:
+        """Per-mix instantaneous offered rate: the base split by weight,
+        then modulated by the diurnal and by every crowd that applies to
+        the mix's tenant (unscoped crowds apply to everyone)."""
+        total_w = sum(max(0.0, m.weight) for m in self.mixes) or 1.0
+        diurnal = self._diurnal_at(t)
+        out = []
+        for m in self.mixes:
+            rate = self.base_rps * max(0.0, m.weight) / total_w * diurnal
+            for crowd in self.flash_crowds:
+                rate *= crowd.factor_at(t, m.tenant)
+            out.append(max(0.0, rate))
+        return out
+
     def rate_at(self, t: float) -> float:
         """Instantaneous offered rate (requests/s of virtual time)."""
-        rate = self.base_rps
-        if self.diurnal_amplitude > 0.0:
-            rate *= 1.0 + self.diurnal_amplitude * math.sin(
-                2.0 * math.pi * t / self.diurnal_period_s
-            )
-        for crowd in self.flash_crowds:
-            rate *= crowd.factor_at(t)
-        return max(0.0, rate)
+        return sum(self.mix_rates_at(t))
 
     def peak_rate(self) -> float:
         """An upper bound on ``rate_at`` — the thinning envelope. Assumes
@@ -126,6 +152,10 @@ class TrafficSpec:
             peak *= max(1.0, crowd.multiplier)
         return max(peak, 1e-9)
 
+    def tenants(self) -> list[str]:
+        """Every tenant the mixes name, default included, sorted."""
+        return sorted({m.tenant for m in self.mixes})
+
     def to_wire(self) -> dict:
         return {
             "duration_s": self.duration_s,
@@ -134,12 +164,17 @@ class TrafficSpec:
             "diurnal_amplitude": self.diurnal_amplitude,
             "diurnal_period_s": self.diurnal_period_s,
             "mixes": [
-                {"model": m.model, "kind": m.kind, "weight": m.weight}
+                {"model": m.model, "kind": m.kind, "weight": m.weight,
+                 # Default tenant omitted: a tenant-less spec's wire form
+                 # (and thus its certificate) stays byte-identical.
+                 **({"tenant": m.tenant}
+                    if m.tenant != tenant_mod.DEFAULT_TENANT else {})}
                 for m in self.mixes
             ],
             "flash_crowds": [
                 {"start_s": c.start_s, "duration_s": c.duration_s,
-                 "multiplier": c.multiplier}
+                 "multiplier": c.multiplier,
+                 **({"tenant": c.tenant} if c.tenant is not None else {})}
                 for c in self.flash_crowds
             ],
         }
@@ -154,15 +189,20 @@ class OpenLoopArrivals:
     def __init__(self, spec: TrafficSpec):
         self.spec = spec
         self._rng = random.Random(spec.seed ^ 0xA11)
-        self._weights = [max(0.0, m.weight) for m in spec.mixes]
-        self._total_weight = sum(self._weights)
-        if self._total_weight <= 0:
+        if sum(max(0.0, m.weight) for m in spec.mixes) <= 0:
             raise ValueError("TrafficSpec.mixes must carry positive weight")
 
-    def _pick_mix(self) -> TrafficMix:
-        x = self._rng.random() * self._total_weight
-        for mix, w in zip(self.spec.mixes, self._weights):
-            x -= w
+    def _pick_mix(self, t: float) -> TrafficMix:
+        """Draw a mix proportional to its INSTANTANEOUS rate: during a
+        tenant-scoped flash crowd the surging tenant's mixes own most of
+        the arrivals, exactly as a real crowd would. With no tenant-scoped
+        crowds every mix scales identically and this reduces to the static
+        weight draw (same RNG call count — legacy seeds replay bit-for-bit)."""
+        rates = self.spec.mix_rates_at(t)
+        total = sum(rates)
+        x = self._rng.random() * total
+        for mix, r in zip(self.spec.mixes, rates):
+            x -= r
             if x <= 0:
                 return mix
         return self.spec.mixes[-1]
@@ -175,7 +215,7 @@ class OpenLoopArrivals:
             if t >= self.spec.duration_s:
                 return
             if self._rng.random() * lam <= self.spec.rate_at(t):
-                yield t, self._pick_mix()
+                yield t, self._pick_mix(t)
 
 
 # ---------------------------------------------------------------------------
@@ -197,7 +237,8 @@ class SimMember:
     EVICT_P = 0.25         # ... with this probability
 
     def __init__(self, net: SimRpcNetwork, addr: str, index: int, *,
-                 seed: int, capacity_qps: float, scrape_timeout_s: float):
+                 seed: int, capacity_qps: float, scrape_timeout_s: float,
+                 tenants: dict[str, tenant_mod.TenantSpec] | None = None):
         self.net = net
         self.addr = addr
         self.slow = (index % self.SLOW_EVERY) == self.SLOW_EVERY - 1
@@ -207,12 +248,41 @@ class SimMember:
         self.burst = max(2.0, self.capacity_qps)
         self._tokens = self.burst
         self._last_refill = net.clock()
+        # Per-tenant token buckets (the sim analogue of AdmissionGate's
+        # TenantLedger): a declared tenant refills at share * capacity, so
+        # its flash crowd drains ITS bucket and sheds typed over_quota
+        # while the member-wide bucket — and every other tenant — keeps
+        # serving. Empty = no enforcement, bit-identical legacy behavior.
+        self.tenants = dict(tenants or {})
+        self._tenant_buckets: dict[str, list[float]] = {}
+        for name, spec in self.tenants.items():
+            rate = max(1e-6, spec.share * self.capacity_qps)
+            burst = max(2.0, rate)
+            self._tenant_buckets[name] = [burst, net.clock(), rate, burst]
+        # Evictions charged to a tenant whose OWN pressure was below the
+        # eviction line (i.e. somebody else's surge would have been the
+        # trigger). The quota ordering makes this structurally zero; the
+        # counter exists so the certificate PROVES it rather than assumes.
+        self.cross_tenant_evictions = 0
         self.obs = observe.ObsService(self.registry, lane=addr)
         self.delegate = ScrapeDelegate(
             net.client(addr), timeout_s=scrape_timeout_s, concurrency=1,
             metrics=self.registry.counters,
         )
         net.serve(addr, self.methods())
+
+    def set_capacity(self, capacity_qps: float) -> None:
+        """Autoscaler actuation in the sim: a capacity change models
+        replicas joining/leaving this member's serving pool. Buckets keep
+        their current fill; only refill rates and ceilings move."""
+        self.capacity_qps = max(1e-6, capacity_qps)
+        self.burst = max(2.0, self.capacity_qps)
+        self._tokens = min(self._tokens, self.burst)
+        for name, spec in self.tenants.items():
+            bucket = self._tenant_buckets[name]
+            bucket[2] = max(1e-6, spec.share * self.capacity_qps)
+            bucket[3] = max(2.0, bucket[2])
+            bucket[0] = min(bucket[0], bucket[3])
 
     def methods(self) -> dict:
         table = traced_methods({
@@ -223,35 +293,82 @@ class SimMember:
         table.update(self.delegate.methods())
         return table
 
-    def _admit(self) -> float:
-        """Take one token or shed; returns utilization in [0, 1]."""
+    def _admit(self, tenant: str) -> tuple[float, float]:
+        """Take one token or shed; returns (member utilization, the
+        pressure the requester's SERVICE should see) — with tenants
+        enforced, that pressure is the requester's OWN bucket: over-share
+        work queues behind its own quota (the sim analogue of the
+        DynamicBatcher/SlotScheduler displacement ordering), so one
+        tenant's surge inflates its own latency and eviction odds, never
+        another tenant's within-quota work."""
         now = self.net.clock()
         self._tokens = min(
             self.burst, self._tokens + (now - self._last_refill) * self.capacity_qps
         )
         self._last_refill = now
         utilization = 1.0 - self._tokens / self.burst
+        evict_pressure = utilization
+        bucket = self._tenant_buckets.get(tenant) if self.tenants else None
+        if self.tenants:
+            if bucket is None:
+                # Unknown tenant: charged against the residual low-priority
+                # share, exactly like TenantLedger's UNKNOWN_SHARE stance.
+                spec = tenant_mod.spec_for(tenant, self.tenants)
+                rate = max(1e-6, spec.share * self.capacity_qps)
+                burst = max(2.0, rate)
+                bucket = self._tenant_buckets[tenant] = [burst, now, rate, burst]
+            bucket[0] = min(bucket[3], bucket[0] + (now - bucket[1]) * bucket[2])
+            bucket[1] = now
+            evict_pressure = 1.0 - bucket[0] / bucket[3]
+            if bucket[0] < 1.0:
+                self.registry.counters.inc("shed")
+                self.registry.counters.inc("shed_over_quota")
+                raise Overloaded(
+                    f"{self.addr}: tenant {tenant!r} at quota",
+                    retry_after_s=0.1, tenant=tenant, quota="over_quota",
+                )
         if self._tokens < 1.0:
             self.registry.counters.inc("shed")
             raise Overloaded(
-                f"{self.addr}: admission queue full", retry_after_s=0.1
+                f"{self.addr}: admission queue full", retry_after_s=0.1,
+                tenant=tenant, quota="gate_full",
             )
         self._tokens -= 1.0
-        return utilization
+        if bucket is not None:
+            bucket[0] -= 1.0
+        return utilization, evict_pressure
 
     def _serve_request(self, p: dict) -> dict:
         kind = str(p.get("kind") or "predict")
+        # The ambient tenant, carried by the RPC frame's `n` field and
+        # re-bound server-side (cluster/rpc.serve_with_deadline) — the
+        # same wire threading production members see.
+        tenant = tenant_mod.current()
         self.registry.counters.inc("requests")
-        utilization = self._admit()
+        utilization, pressure = self._admit(tenant)
         service = KIND_SERVICE_S.get(kind, 0.1) * (0.5 + self.rng.random())
         if self.slow:
             service *= self.SLOW_FACTOR
-        service *= 1.0 + self.PRESSURE_GAIN * utilization
+        # With no tenant table, ``pressure`` IS the member utilization —
+        # legacy runs are bit-identical. With tenants enforced it is the
+        # requester's own-quota pressure, so a surging tenant's latency
+        # degrades (and burns ITS SLO lane) while within-quota tenants
+        # keep their service times.
+        service *= 1.0 + self.PRESSURE_GAIN * pressure
         if (
             kind == "generate"
-            and utilization > self.EVICT_PRESSURE
+            and pressure > self.EVICT_PRESSURE
             and self.rng.random() < self.EVICT_P
         ):
+            # Recorded assertion: with tenants enforced the eviction
+            # trigger IS the requester's own-bucket pressure, so a
+            # within-quota tenant can never stand here — mirroring
+            # SlotScheduler's victim ordering. If a future edit decouples
+            # trigger from victim, this counter (summed into the
+            # certificate's cross_tenant_evictions, pinned at zero) is
+            # what catches it.
+            if self.tenants and pressure <= self.EVICT_PRESSURE:
+                self.cross_tenant_evictions += 1
             self.registry.counters.inc("evicted")
             raise RpcError(f"evicted: {self.addr} kv-cache pressure")
         budget = float(p.get("deadline_s") or KIND_DEADLINE_S.get(kind, 1.0))
@@ -279,6 +396,7 @@ class ModelTally:
     requests: int = 0
     ok: int = 0
     shed: int = 0
+    shed_over_quota: int = 0  # subset of shed: typed tenant-quota refusals
     deadline: int = 0
     evicted: int = 0
     error: int = 0
@@ -311,7 +429,13 @@ class ReplayHarness:
         burn_force_sample_s: float = 15.0,
         fast_burn: float = 6.0,
         slow_burn: float = 1.5,
+        fast_window_s: float | None = None,
         capacity_headroom: float = 2.0,
+        tenants: dict[str, tenant_mod.TenantSpec] | None = None,
+        autoscale: bool = False,
+        autoscale_max_units: int = 8,
+        autoscale_clear_windows: int = 3,
+        autoscale_moves_budget: int = 2,
     ):
         if n_members < 2:
             raise ValueError("certification needs at least 2 members")
@@ -320,15 +444,20 @@ class ReplayHarness:
         self.spans_per_s_budget = float(spans_per_s_budget)
         self.scrape_interval_s = float(scrape_interval_s)
         self.burn_force_sample_s = float(burn_force_sample_s)
+        # Declared tenant table (cluster/tenant.py specs). When the spec's
+        # mixes name tenants that aren't declared, they still flow — as
+        # unknown low-priority tenants, like the production gates.
+        self.tenant_specs = dict(tenants or {})
 
         self.net = SimRpcNetwork()
         self.leader_addr = "leader:0"
         self.member_addrs = [f"m{i:03d}:1" for i in range(n_members)]
-        per_member_qps = capacity_headroom * spec.base_rps / n_members
+        self.per_member_qps = capacity_headroom * spec.base_rps / n_members
         self.members = [
             SimMember(self.net, addr, i, seed=spec.seed,
-                      capacity_qps=per_member_qps,
-                      scrape_timeout_s=scrape_timeout_s)
+                      capacity_qps=self.per_member_qps,
+                      scrape_timeout_s=scrape_timeout_s,
+                      tenants=self.tenant_specs)
             for i, addr in enumerate(self.member_addrs)
         ]
         self.leader_registry = Registry()
@@ -347,21 +476,68 @@ class ReplayHarness:
         if objectives is None:
             objectives = self.default_objectives(spec)
         self.objectives = objectives
+        # The fast window bounds detection latency: the evaluator needs
+        # roughly fast_burn * error_budget * window of over-objective
+        # samples before it alerts, so a tight-convergence scenario (the
+        # autoscaler certification) passes a short window here.
+        if fast_window_s is None:
+            fast_window_s = min(30.0, spec.duration_s)
         self.slo = SloEvaluator(
             self.profiler, objectives,
-            fast_window_s=min(30.0, spec.duration_s),
+            fast_window_s=min(float(fast_window_s), spec.duration_s),
             slow_window_s=spec.duration_s,
             fast_burn=fast_burn, slow_burn=slow_burn, stage="dispatch",
             metrics=self.leader_registry.counters,
+            # Per-tenant burn lanes: every non-default tenant the traffic
+            # names gets its own model@tenant lane, scored against the
+            # model objective on that tenant's traffic only.
+            tenants=[t for t in spec.tenants()
+                     if t != tenant_mod.DEFAULT_TENANT],
         )
         self._dispatch_rng = random.Random(spec.seed ^ 0xD15)
         self.tallies: dict[str, ModelTally] = {}
+        # tenant -> model -> tally (the certificate's per-tenant section).
+        self.tenant_tallies: dict[str, dict[str, ModelTally]] = {}
         self.error_traces: set[str] = set()
         self.scrape_cycles = 0
         self.leader_scrape_rpcs = 0
         self.stale_spans_total = 0
         self.redelegations_total = 0
         self.force_windows = 0
+        # The elastic loop under certification (scheduler/autoscaler.py):
+        # the REAL Autoscaler on the virtual clock, actuating simulated
+        # capacity units (each unit = the baseline per-member qps, i.e. a
+        # replica's worth of serving). The certificate pins convergence:
+        # scale-up within the fast-burn windows, scale-down after quiet.
+        self.autoscaler: Autoscaler | None = None
+        self.flight: FlightRecorder | None = None
+        self._capacity_units = 1
+        self._first_burn_cycle: int | None = None
+        self._first_up_cycle: int | None = None
+        self._first_down_cycle: int | None = None
+        self._breach_after_down = False
+        if autoscale:
+            self.flight = FlightRecorder(clock=self.net.clock, node="loadgen")
+            self.autoscaler = Autoscaler(
+                flight=self.flight,
+                metrics=self.leader_registry.counters,
+                clock=self.net.clock,
+                clear_windows=autoscale_clear_windows,
+                moves_budget=autoscale_moves_budget,
+            )
+            self.autoscaler.register(ScaleTarget(
+                "sim_capacity",
+                get=lambda: self._capacity_units,
+                apply=self._apply_capacity_units,
+                lo=1,
+                hi=max(1, int(autoscale_max_units)),
+            ))
+
+    def _apply_capacity_units(self, units: int) -> int:
+        self._capacity_units = max(1, int(units))
+        for member in self.members:
+            member.set_capacity(self.per_member_qps * self._capacity_units)
+        return self._capacity_units
 
     @staticmethod
     def default_objectives(spec: TrafficSpec) -> dict[str, SloObjective]:
@@ -427,8 +603,28 @@ class ReplayHarness:
         self.redelegations_total += result.redelegations
         for addr, reply in result.members.items():
             self.profiler.ingest_scrape(addr, reply)
-        self.slo.evaluate()
+        state = self.slo.evaluate()
         burning = self.slo.burning_models()
+        if self.autoscaler is not None:
+            if burning and self._first_burn_cycle is None:
+                self._first_burn_cycle = self.scrape_cycles
+            decisions = self.autoscaler.tick(
+                burning, {lane: st.get("fast", 0.0)
+                          for lane, st in state.items()},
+            )
+            for decision in decisions:
+                if decision["direction"] == "up" \
+                        and self._first_up_cycle is None:
+                    self._first_up_cycle = self.scrape_cycles
+                if decision["direction"] == "down" \
+                        and self._first_down_cycle is None:
+                    self._first_down_cycle = self.scrape_cycles
+            if burning and self._first_down_cycle is not None \
+                    and self.scrape_cycles > self._first_down_cycle:
+                # A burn AFTER the scale-down would mean the shrink broke
+                # the SLO it just restored — the flap the hysteresis and
+                # clear-window discipline exist to prevent.
+                self._breach_after_down = True
         if burning and self.burn_force_sample_s > 0:
             # The same hook the real leader runs (cluster/node.py): a model
             # burning budget flips the whole fleet to forced sampling.
@@ -439,16 +635,37 @@ class ReplayHarness:
             )
             self.force_windows += 1
 
+    def _tally_pair(self, mix: TrafficMix) -> tuple[ModelTally, ModelTally]:
+        """(per-model aggregate, per-(tenant, model)) tallies for one
+        request; both counted on every outcome so the certificate's tenant
+        outcome counts sum exactly like the model ones."""
+        tally = self.tallies.setdefault(mix.model, ModelTally(kind=mix.kind))
+        per_tenant = self.tenant_tallies.setdefault(mix.tenant, {})
+        tenant_tally = per_tenant.setdefault(mix.model, ModelTally(kind=mix.kind))
+        return tally, tenant_tally
+
+    def _record_latency(self, mix: TrafficMix, member: str,
+                        latency: float) -> None:
+        """One observed latency into the SLO lanes: the bare model lane
+        (the aggregate every legacy consumer reads) AND, for a non-default
+        tenant, the model@tenant composite the per-tenant burn is scored
+        on."""
+        self.profiler.record(mix.model, member, "dispatch", latency)
+        lane = tenant_lane(mix.model, mix.tenant)
+        if lane != mix.model:
+            self.profiler.record(lane, member, "dispatch", latency)
+
     def _dispatch(self, mix: TrafficMix) -> None:
         member = self.member_addrs[
             self._dispatch_rng.randrange(len(self.member_addrs))
         ]
         budget = KIND_DEADLINE_S.get(mix.kind, 1.0)
-        tally = self.tallies.setdefault(mix.model, ModelTally(kind=mix.kind))
+        tally, tenant_tally = self._tally_pair(mix)
         tally.requests += 1
+        tenant_tally.requests += 1
         trace_id = ""
         try:
-            with tracing.tracer.span(
+            with tenant_mod.bind(mix.tenant), tracing.tracer.span(
                 "loadgen/request", model=mix.model, kind=mix.kind
             ):
                 ctx = tracectx.current()
@@ -458,29 +675,39 @@ class ReplayHarness:
                     {"model": mix.model, "kind": mix.kind, "deadline_s": budget},
                     timeout=budget,
                 )
-        except Overloaded:
+        except Overloaded as e:
             tally.shed += 1
+            tenant_tally.shed += 1
+            if getattr(e, "quota", None) == "over_quota":
+                tally.shed_over_quota += 1
+                tenant_tally.shed_over_quota += 1
             self.error_traces.add(trace_id)
             return
         except DeadlineExceeded:
             tally.deadline += 1
+            tenant_tally.deadline += 1
             tally.latencies.append(budget)
+            tenant_tally.latencies.append(budget)
             self.error_traces.add(trace_id)
             # The caller waited its whole budget: that latency is real and
             # lands in the SLO lane as an over-objective observation.
-            self.profiler.record(mix.model, member, "dispatch", budget)
+            self._record_latency(mix, member, budget)
             return
         except (RpcUnreachable, RpcError) as e:
             if "evicted:" in str(e):
                 tally.evicted += 1
+                tenant_tally.evicted += 1
             else:
                 tally.error += 1
+                tenant_tally.error += 1
             self.error_traces.add(trace_id)
             return
         tally.ok += 1
+        tenant_tally.ok += 1
         latency = float(reply["service_s"])
         tally.latencies.append(latency)
-        self.profiler.record(mix.model, member, "dispatch", latency)
+        tenant_tally.latencies.append(latency)
+        self._record_latency(mix, member, latency)
 
     # ---- certificate ---------------------------------------------------
 
@@ -519,6 +746,7 @@ class ReplayHarness:
                 "requests": tally.requests,
                 "ok": tally.ok,
                 "shed": tally.shed,
+                "shed_over_quota": tally.shed_over_quota,
                 "deadline": tally.deadline,
                 "evicted": tally.evicted,
                 "error": tally.error,
@@ -531,6 +759,13 @@ class ReplayHarness:
                 "fast_alert": slo_model.get("fast_alert", False),
                 "slow_alert": slo_model.get("slow_alert", False),
             }
+        extra: dict[str, dict] = {}
+        tenants_doc = self._tenants_section()
+        if tenants_doc is not None:
+            extra["tenants"] = tenants_doc
+        autoscaler_doc = self._autoscaler_section()
+        if autoscaler_doc is not None:
+            extra["autoscaler"] = autoscaler_doc
         return self._jsonsafe({
             "version": SLO_CERT_VERSION,
             "seed": self.spec.seed,
@@ -570,7 +805,103 @@ class ReplayHarness:
                     if ev.get("ph") == "X"
                 ),
             },
+            **extra,
         })
+
+    def _tenants_section(self) -> dict | None:
+        """Per-tenant certification: outcome counts per (tenant, model),
+        each tenant-model p99 judged against the MODEL's objective, and
+        the fleet-summed cross-tenant eviction count the isolation pin
+        requires to be zero. Absent entirely for tenant-less traffic —
+        legacy certificates don't grow a section of empty rows."""
+        only_default = set(self.tenant_tallies) <= {tenant_mod.DEFAULT_TENANT}
+        if not self.tenant_specs and only_default:
+            return None
+        tenants: dict[str, dict] = {}
+        for tenant in sorted(set(self.tenant_tallies) | set(self.tenant_specs)):
+            spec = tenant_mod.spec_for(tenant, self.tenant_specs)
+            per_model: dict[str, dict] = {}
+            totals = ModelTally()
+            for model, tally in sorted(
+                (self.tenant_tallies.get(tenant) or {}).items()
+            ):
+                objective = self.objectives.get(model)
+                p99 = tally.percentile(99)
+                per_model[model] = {
+                    "kind": tally.kind,
+                    "requests": tally.requests,
+                    "ok": tally.ok,
+                    "shed": tally.shed,
+                    "shed_over_quota": tally.shed_over_quota,
+                    "deadline": tally.deadline,
+                    "evicted": tally.evicted,
+                    "error": tally.error,
+                    "p50_s": tally.percentile(50),
+                    "p99_s": p99,
+                    "objective_latency_s": (
+                        objective.latency_s if objective else None
+                    ),
+                    "certified": (
+                        p99 is None or objective is None
+                        or p99 <= objective.latency_s
+                    ),
+                }
+                totals.requests += tally.requests
+                totals.ok += tally.ok
+                totals.shed += tally.shed
+                totals.shed_over_quota += tally.shed_over_quota
+                totals.deadline += tally.deadline
+                totals.evicted += tally.evicted
+                totals.error += tally.error
+            tenants[tenant] = {
+                "priority": spec.priority,
+                "share": spec.share,
+                "requests": totals.requests,
+                "ok": totals.ok,
+                "shed": totals.shed,
+                "shed_over_quota": totals.shed_over_quota,
+                "deadline": totals.deadline,
+                "evicted": totals.evicted,
+                "error": totals.error,
+                "models": per_model,
+                "certified": all(
+                    body["certified"] for body in per_model.values()
+                ),
+            }
+        return {
+            "declared": sorted(self.tenant_specs),
+            "cross_tenant_evictions": sum(
+                m.cross_tenant_evictions for m in self.members
+            ),
+            "tenants": tenants,
+        }
+
+    def _autoscaler_section(self) -> dict | None:
+        """Convergence evidence for the elastic loop: when the first burn
+        was seen, how many scrape cycles until the first scale-up, whether
+        the fleet scaled back down after quiet, and whether the SLO burned
+        again AFTER the scale-down (it must not). The full decision ring —
+        every one also flight-recorded — rides along."""
+        if self.autoscaler is None:
+            return None
+        up_cycles = None
+        if self._first_burn_cycle is not None and self._first_up_cycle is not None:
+            up_cycles = self._first_up_cycle - self._first_burn_cycle + 1
+        return {
+            "enabled": True,
+            "capacity_units": self._capacity_units,
+            "first_burn_cycle": self._first_burn_cycle,
+            "first_up_cycle": self._first_up_cycle,
+            "first_down_cycle": self._first_down_cycle,
+            "scale_up_cycles": up_cycles,
+            "scaled_down": self._first_down_cycle is not None,
+            "breach_after_scale_down": self._breach_after_down,
+            "decisions": list(self.autoscaler.decisions),
+            "flight_recorded": (
+                self.flight.to_wire()["recorded"]
+                if self.flight is not None else 0
+            ),
+        }
 
 
 # ---------------------------------------------------------------------------
@@ -602,10 +933,18 @@ _CERT_SHAPE: dict[str, dict[str, tuple]] = {
 
 _MODEL_SHAPE: dict[str, tuple] = {
     "kind": (str,), "requests": (int,), "ok": (int,), "shed": (int,),
+    "shed_over_quota": (int,),
     "deadline": (int,), "evicted": (int,), "error": (int,),
     "p50_s": (*_NUM, type(None)), "p99_s": (*_NUM, type(None)),
     "fast_burn": _NUM, "slow_burn": _NUM,
     "fast_alert": (bool,), "slow_alert": (bool,),
+}
+
+_TENANT_SHAPE: dict[str, tuple] = {
+    "priority": (str,), "share": _NUM,
+    "requests": (int,), "ok": (int,), "shed": (int,),
+    "shed_over_quota": (int,), "deadline": (int,), "evicted": (int,),
+    "error": (int,), "models": (dict,), "certified": (bool,),
 }
 
 
@@ -655,10 +994,172 @@ def validate_slo_cert(doc: dict) -> list[str]:
         )
         if counted != int(body.get("requests") or 0):
             problems.append(f"models.{model}: outcome counts != requests")
+    problems.extend(_validate_tenants(doc, models))
+    problems.extend(_validate_autoscaler(doc))
     return problems
 
 
+def _validate_tenants(doc: dict, models: dict) -> list[str]:
+    """The per-tenant section's invariants (optional section — absent on
+    tenant-less certificates): every tenant's outcome counts must sum to
+    its requests, the tenants' request totals must account for EXACTLY the
+    model totals (no request untallied, none double-counted), and the
+    cross-tenant eviction count must be present (the isolation pin reads
+    it)."""
+    body = doc.get("tenants")
+    if body is None:
+        return []
+    problems: list[str] = []
+    if not isinstance(body, dict) or not isinstance(body.get("tenants"), dict):
+        return ["tenants section is not an object with a tenants map"]
+    if not isinstance(body.get("cross_tenant_evictions"), int):
+        problems.append("tenants.cross_tenant_evictions missing")
+    tenant_requests = 0
+    for tenant, tbody in body["tenants"].items():
+        if not isinstance(tbody, dict):
+            problems.append(f"tenants.{tenant} is not an object")
+            continue
+        for key, types in _TENANT_SHAPE.items():
+            if key not in tbody:
+                problems.append(f"tenants.{tenant}.{key} missing")
+            elif not isinstance(tbody[key], types) or (
+                isinstance(tbody[key], bool) and bool not in types
+            ):
+                problems.append(f"tenants.{tenant}.{key} has wrong type")
+        counted = sum(
+            int(tbody.get(k) or 0)
+            for k in ("ok", "shed", "deadline", "evicted", "error")
+        )
+        if counted != int(tbody.get("requests") or 0):
+            problems.append(f"tenants.{tenant}: outcome counts != requests")
+        for model, mbody in (tbody.get("models") or {}).items():
+            if not isinstance(mbody, dict):
+                problems.append(f"tenants.{tenant}.models.{model} not an object")
+                continue
+            mcounted = sum(
+                int(mbody.get(k) or 0)
+                for k in ("ok", "shed", "deadline", "evicted", "error")
+            )
+            if mcounted != int(mbody.get("requests") or 0):
+                problems.append(
+                    f"tenants.{tenant}.models.{model}: "
+                    "outcome counts != requests"
+                )
+        tenant_requests += int(tbody.get("requests") or 0)
+    model_requests = sum(
+        int((m or {}).get("requests") or 0) for m in models.values()
+        if isinstance(m, dict)
+    )
+    if tenant_requests != model_requests:
+        problems.append(
+            f"tenants request total {tenant_requests} != "
+            f"models request total {model_requests}"
+        )
+    return problems
+
+
+def _validate_autoscaler(doc: dict) -> list[str]:
+    """The autoscaler section's invariants (optional section): decision
+    list present and every decision carries a direction + trigger, the
+    flight-recorded count covers the decisions, and a clean run never
+    burned after its scale-down."""
+    body = doc.get("autoscaler")
+    if body is None:
+        return []
+    problems: list[str] = []
+    if not isinstance(body, dict):
+        return ["autoscaler section is not an object"]
+    decisions = body.get("decisions")
+    if not isinstance(decisions, list):
+        problems.append("autoscaler.decisions missing")
+        decisions = []
+    for i, decision in enumerate(decisions):
+        if not isinstance(decision, dict) or "direction" not in decision \
+                or "trigger" not in decision:
+            problems.append(f"autoscaler.decisions[{i}] lacks direction/trigger")
+    recorded = body.get("flight_recorded")
+    if not isinstance(recorded, int) or recorded < len(decisions):
+        problems.append("autoscaler.flight_recorded < decisions")
+    if not isinstance(body.get("breach_after_scale_down"), bool):
+        problems.append("autoscaler.breach_after_scale_down missing")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# The canonical tenant-isolation scenario
+# ---------------------------------------------------------------------------
+#
+# One definition, three consumers: tests/test_autoscaler.py pins its
+# verdicts across the chaos-seed matrix, tools/slo_cert.py --tenants
+# replays it standalone, and tools/ci_check.sh runs that per seed leg.
+# Tenant "acme" (low priority, half share) takes a 10x flash crowd while
+# the default tenant's steady traffic rides the same members; the
+# certificate must show acme shedding typed over-quota inside its own
+# allowance, the default tenant's p99 certified, zero cross-tenant
+# evictions, and the autoscaler scaling up on the burn edge then back
+# down after quiet without re-breaching.
+
+ISOLATION_TENANTS: dict[str, dict[str, object]] = {
+    "acme": {"priority": "low", "share": 0.5},
+}
+
+
+def two_tenant_flash_spec(
+    seed: int,
+    *,
+    base_rps: float = 40.0,
+    duration_s: float = 240.0,
+    surge_start_s: float = 30.0,
+    surge_duration_s: float = 30.0,
+    surge_multiplier: float = 10.0,
+) -> TrafficSpec:
+    """The pinned two-tenant traffic shape: default tenant serves a
+    steady predict+generate mix; tenant ``acme`` runs generate traffic
+    and takes a tenant-scoped flash crowd."""
+    return TrafficSpec(
+        mixes=(
+            TrafficMix("resnet50", "predict", 0.5),
+            TrafficMix("llm-7b", "generate", 0.2),
+            TrafficMix("llm-7b", "generate", 0.3, tenant="acme"),
+        ),
+        base_rps=base_rps,
+        duration_s=duration_s,
+        flash_crowds=(
+            FlashCrowd(
+                start_s=surge_start_s,
+                duration_s=surge_duration_s,
+                multiplier=surge_multiplier,
+                tenant="acme",
+            ),
+        ),
+        seed=seed,
+    )
+
+
+def tenant_isolation_harness(
+    n_members: int, seed: int, **overrides: Any
+) -> ReplayHarness:
+    """ReplayHarness wired for the isolation certification: quota
+    enforcement on, the real autoscaler actuating sim capacity, a short
+    fast-burn window (detection latency bounds how much of the surge
+    leaks into latency before the scale-up), and a clear-window run
+    longer than the surge so the scale-down happens after quiet, not
+    mid-crowd."""
+    params: dict[str, Any] = dict(
+        tenants=tenant_mod.parse_tenants(ISOLATION_TENANTS),
+        autoscale=True,
+        autoscale_max_units=8,
+        autoscale_clear_windows=12,
+        capacity_headroom=2.0,
+        scrape_interval_s=2.5,
+        fast_window_s=5.0,
+    )
+    params.update(overrides)
+    return ReplayHarness(n_members, two_tenant_flash_spec(seed), **params)
+
+
 __all__ = [
+    "ISOLATION_TENANTS",
     "SLO_CERT_VERSION",
     "FlashCrowd",
     "ModelTally",
@@ -667,5 +1168,7 @@ __all__ = [
     "SimMember",
     "TrafficMix",
     "TrafficSpec",
+    "tenant_isolation_harness",
+    "two_tenant_flash_spec",
     "validate_slo_cert",
 ]

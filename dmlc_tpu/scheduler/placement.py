@@ -42,7 +42,21 @@ from dataclasses import dataclass, field
 from time import monotonic
 from typing import Any, Callable
 
+from dmlc_tpu.cluster import tenant as tenant_mod
+
 log = logging.getLogger(__name__)
+
+
+def tenant_lane(model: str, tenant: str) -> str:
+    """Composite profiler model key for one tenant's share of a model's
+    traffic (``model@tenant``). The default tenant rides the bare model
+    lane, so a tenant-less fleet records exactly what it always did; the
+    dispatch paths record BOTH the bare lane (the aggregate every existing
+    consumer reads) and the composite one when a non-default tenant is
+    ambient."""
+    if not tenant or tenant == tenant_mod.DEFAULT_TENANT:
+        return model
+    return f"{model}@{tenant}"
 
 
 # ---------------------------------------------------------------------------
@@ -100,6 +114,8 @@ class SloEvaluator:
         flight: Any = None,
         registry: Any = None,
         on_fast_burn: Callable[[str], None] | None = None,
+        tenants: list[str] | None = None,
+        tenant_guard: Any = None,
     ) -> None:
         self.profiler = profiler
         self.objectives = dict(objectives)
@@ -111,31 +127,56 @@ class SloEvaluator:
         self.metrics = metrics
         self.flight = flight
         self.on_fast_burn = on_fast_burn
-        # model -> {"fast": burn, "slow": burn, "fast_alert": bool, ...}
+        # Declared tenants (utils/config ``tenants``): each gets its own
+        # burn lane per model, scored against the MODEL's objective — the
+        # per-tenant promise is the same latency bound, evaluated on that
+        # tenant's traffic only (profiler lane ``model@tenant``).
+        self.tenants = sorted(tenants or [])
+        # utils/metrics.TenantLabelGuard (optional): bounds per-tenant
+        # gauge label cardinality.
+        self.tenant_guard = tenant_guard
+        # lane -> {"fast": burn, "slow": burn, "fast_alert": bool, ...}
+        # where lane is the model (aggregate) or "model@tenant".
         self._state: dict[str, dict] = {
-            m: {"fast": 0.0, "slow": 0.0, "fast_alert": False, "slow_alert": False}
-            for m in self.objectives
+            lane: {"fast": 0.0, "slow": 0.0, "fast_alert": False,
+                   "slow_alert": False}
+            for m in self.objectives for lane in self._lanes(m)
         }
         self._lock = threading.Lock()
         if registry is not None:
             for model in self.objectives:
-                registry.gauge(
-                    f"slo_fast_burn_{model}",
-                    lambda m=model: self._state[m]["fast"],
-                )
-                registry.gauge(
-                    f"slo_slow_burn_{model}",
-                    lambda m=model: self._state[m]["slow"],
-                )
+                for lane in self._lanes(model):
+                    name = lane if lane == model else self._gauge_label(lane, model)
+                    registry.gauge(
+                        f"slo_fast_burn_{name}",
+                        lambda ln=lane: self._state[ln]["fast"],
+                    )
+                    registry.gauge(
+                        f"slo_slow_burn_{name}",
+                        lambda ln=lane: self._state[ln]["slow"],
+                    )
 
-    def _burn(self, obj: SloObjective, horizon_s: float) -> float:
+    def _lanes(self, model: str) -> list[str]:
+        """The aggregate lane plus one per declared tenant."""
+        return [model] + [f"{model}@{t}" for t in self.tenants]
+
+    def _gauge_label(self, lane: str, model: str) -> str:
+        tenant = lane[len(model) + 1:]
+        if self.tenant_guard is not None:
+            tenant = self.tenant_guard.label(tenant)
+        return f"{model}@{tenant}"
+
+    def _burn(self, obj: SloObjective, horizon_s: float,
+              lane: str | None = None) -> float:
         frac = self.profiler.frac_over(
-            obj.latency_s, model=obj.model, stage=self.stage, horizon_s=horizon_s
+            obj.latency_s, model=lane or obj.model, stage=self.stage,
+            horizon_s=horizon_s,
         )
         return frac / obj.error_budget
 
     def evaluate(self) -> dict[str, dict]:
-        """One evaluation pass over every objective. Returns the per-model
+        """One evaluation pass over every objective — aggregate per model
+        plus one lane per declared (model, tenant). Returns the per-lane
         state after the pass. Alert edge-transitions record flight events
         and counters; entering fast burn fires ``on_fast_burn`` (after the
         evaluator's own lock is released — the callback takes the
@@ -143,38 +184,43 @@ class SloEvaluator:
         fired: list[str] = []
         with self._lock:
             for model, obj in sorted(self.objectives.items()):
-                st = self._state[model]
-                st["fast"] = self._burn(obj, self.fast_window_s)
-                st["slow"] = self._burn(obj, self.slow_window_s)
-                for win, threshold in (("fast", self.fast_burn),
-                                       ("slow", self.slow_burn)):
-                    alert_key = f"{win}_alert"
-                    if not st[alert_key] and st[win] >= threshold:
-                        st[alert_key] = True
-                        if self.metrics is not None:
-                            self.metrics.inc(f"slo_{win}_burn_alerts")
-                        if self.flight is not None:
-                            self.flight.note(
-                                f"slo_{win}_burn", model=model,
-                                burn=round(st[win], 3), threshold=threshold,
-                                objective_s=obj.latency_s,
-                            )
-                        log.warning("SLO %s burn for %s: %.1fx budget "
-                                    "(threshold %.1fx)", win, model, st[win],
-                                    threshold)
-                        if win == "fast":
-                            fired.append(model)
-                    elif st[alert_key] and st[win] <= self.CLEAR_FRACTION * threshold:
-                        st[alert_key] = False
-                        if self.flight is not None:
-                            self.flight.note(
-                                "slo_burn_clear", model=model, window=win,
-                                burn=round(st[win], 3),
-                            )
+                for lane in self._lanes(model):
+                    tenant = lane[len(model) + 1:] if lane != model else None
+                    st = self._state[lane]
+                    st["fast"] = self._burn(obj, self.fast_window_s, lane=lane)
+                    st["slow"] = self._burn(obj, self.slow_window_s, lane=lane)
+                    for win, threshold in (("fast", self.fast_burn),
+                                           ("slow", self.slow_burn)):
+                        alert_key = f"{win}_alert"
+                        if not st[alert_key] and st[win] >= threshold:
+                            st[alert_key] = True
+                            if self.metrics is not None:
+                                self.metrics.inc(f"slo_{win}_burn_alerts")
+                            if self.flight is not None:
+                                self.flight.note(
+                                    f"slo_{win}_burn", model=model,
+                                    burn=round(st[win], 3), threshold=threshold,
+                                    objective_s=obj.latency_s,
+                                    **({"tenant": tenant} if tenant else {}),
+                                )
+                            log.warning("SLO %s burn for %s: %.1fx budget "
+                                        "(threshold %.1fx)", win, lane,
+                                        st[win], threshold)
+                            if win == "fast":
+                                fired.append(lane)
+                        elif st[alert_key] and \
+                                st[win] <= self.CLEAR_FRACTION * threshold:
+                            st[alert_key] = False
+                            if self.flight is not None:
+                                self.flight.note(
+                                    "slo_burn_clear", model=model, window=win,
+                                    burn=round(st[win], 3),
+                                    **({"tenant": tenant} if tenant else {}),
+                                )
             out = {m: dict(st) for m, st in self._state.items()}
         if self.on_fast_burn is not None:
-            for model in fired:
-                self.on_fast_burn(model)
+            for lane in fired:
+                self.on_fast_burn(lane)
         return out
 
     def status(self) -> dict:
@@ -190,7 +236,7 @@ class SloEvaluator:
         }
         for model, obj in sorted(self.objectives.items()):
             st = state.get(model, {})
-            out["models"][model] = {
+            body: dict = {
                 "objective_latency_s": obj.latency_s,
                 "availability": obj.availability,
                 "p99_s": self.profiler.percentile(
@@ -202,11 +248,29 @@ class SloEvaluator:
                 "fast_alert": st.get("fast_alert", False),
                 "slow_alert": st.get("slow_alert", False),
             }
+            if self.tenants:
+                body["tenants"] = {
+                    t: {
+                        "p99_s": self.profiler.percentile(
+                            99, model=f"{model}@{t}", stage=self.stage,
+                            horizon_s=self.fast_window_s,
+                        ),
+                        "fast_burn": state.get(f"{model}@{t}", {}).get("fast", 0.0),
+                        "slow_burn": state.get(f"{model}@{t}", {}).get("slow", 0.0),
+                        "fast_alert": state.get(f"{model}@{t}", {}).get(
+                            "fast_alert", False),
+                        "slow_alert": state.get(f"{model}@{t}", {}).get(
+                            "slow_alert", False),
+                    }
+                    for t in self.tenants
+                }
+            out["models"][model] = body
         return out
 
     def burning_models(self) -> list[str]:
-        """Models currently in fast-burn alert — what the leader's
-        forced-sampling hook and the SLO-cert harness key off."""
+        """Lanes currently in fast-burn alert (bare models plus any
+        ``model@tenant`` composites) — what the leader's forced-sampling
+        hook, the autoscaler, and the SLO-cert harness key off."""
         with self._lock:
             return sorted(
                 m for m, st in self._state.items() if st.get("fast_alert")
@@ -294,6 +358,23 @@ class PlacementAdvisor:
         self._excluded: set[str] = set()
         self._moves_used = 0
         self._window_start: float | None = None
+        # Replica targets (scheduler/autoscaler.py): per-job bound on how
+        # many members the solver may deal to the job. The greedy dealer
+        # naturally spreads every eligible member across jobs, so SHRINKING
+        # the target is the actuation that matters (growing = raising it
+        # back). For a gang job the target instead WIDENS the gang past its
+        # minimal memory-fit width — more shards, more aggregate HBM
+        # bandwidth — and never shrinks below what fits. Empty = unbounded
+        # (pre-autoscaler behavior, bit for bit).
+        self.replica_targets: dict[str, int] = {}
+
+    def set_replica_target(self, job: str, target: int | None) -> None:
+        """Bound (or, for gangs, widen to) ``target`` members for ``job``.
+        None or <= 0 clears the bound."""
+        if target is None or target <= 0:
+            self.replica_targets.pop(job, None)
+        else:
+            self.replica_targets[job] = int(target)
 
     # ---- cost model ----------------------------------------------------
 
@@ -414,6 +495,20 @@ class PlacementAdvisor:
             share = need_bytes / width
             fits = [m for m in ranked if room.get(m, float("inf")) >= share]
             if len(fits) >= width:
+                want = self.replica_targets.get(job)
+                if want is not None and want > width:
+                    # Autoscaler asked for more fan-out than the minimal
+                    # fit: widen while enough members hold the (smaller)
+                    # per-shard share. Memory fit still wins — the target
+                    # never narrows a gang below what fits.
+                    for w2 in range(min(want, len(ranked)), width, -1):
+                        share2 = need_bytes / w2
+                        fits2 = [
+                            m for m in ranked
+                            if room.get(m, float("inf")) >= share2
+                        ]
+                        if len(fits2) >= w2:
+                            return fits2[:w2], w2
                 return fits[:width], width
         return None
 
@@ -600,6 +695,11 @@ class PlacementAdvisor:
                     f"{j}:{w}={','.join(plan.assignment[j])}"
                     for j, w in sorted(plan.gangs.items())
                 )
+            if self.replica_targets:
+                # Autoscaler bounds shaped this plan (lint O2).
+                note["replica_targets"] = ",".join(
+                    f"{j}={t}" for j, t in sorted(self.replica_targets.items())
+                )
             self.flight.note("placement_decision", **note)
         return plan
 
@@ -621,10 +721,15 @@ class PlacementAdvisor:
         }
         granted = {n: 0.0 for n in names}
         assignment: dict[str, list[str]] = {n: [] for n in names}
+        caps = self.replica_targets
         for m in sorted(eligible, key=lambda m: (-capacity[m], m)):
             # Most-starved job first: demand per granted capacity, with
             # empty jobs infinitely starved so everyone gets one member.
-            candidates = [n for n in names if m not in blocked.get(n, ())]
+            candidates = [
+                n for n in names
+                if m not in blocked.get(n, ())
+                and len(assignment[n]) < caps.get(n, len(eligible) + 1)
+            ]
             if not candidates:
                 continue  # member too full for every job this pass
             target = max(
@@ -673,14 +778,21 @@ class PlacementAdvisor:
             moves += sum(1 for m in ms if m not in before)
         return moves
 
-    @staticmethod
-    def _plan_stale(previous: PlacementPlan, jobs: dict[str, int],
+    def _plan_stale(self, previous: PlacementPlan, jobs: dict[str, int],
                     members: set[str]) -> bool:
         """A cached plan is unusable (bypasses hysteresis/budget) when it
-        references departed members or misses a job entirely."""
+        references departed members, misses a job entirely, or deals a job
+        more SOLO members than its replica target allows — a shrink from
+        the autoscaler must land this advise, not after the hysteresis
+        gate happens to open."""
         for name in jobs:
             ms = previous.assignment.get(name)
             if not ms or any(m not in members for m in ms):
+                return True
+        for name, target in self.replica_targets.items():
+            if name in previous.gangs:
+                continue  # gang width is memory-driven; target only widens
+            if len(previous.assignment.get(name, ())) > target:
                 return True
         return False
 
@@ -701,6 +813,7 @@ class PlacementAdvisor:
                 n: list(ms) for n, ms in sorted(plan.assignment.items())
             },
             "gangs": {} if plan is None else dict(sorted(plan.gangs.items())),
+            "replica_targets": dict(sorted(self.replica_targets.items())),
         }
 
 

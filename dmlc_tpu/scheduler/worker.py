@@ -23,6 +23,7 @@ from collections import OrderedDict
 from pathlib import Path
 from typing import Callable, Protocol, Sequence
 
+from dmlc_tpu.cluster import tenant as tenant_mod
 from dmlc_tpu.cluster.rpc import DecodeError, Overloaded, RpcError
 from dmlc_tpu.utils.hotpath import hot_path
 from dmlc_tpu.utils.metrics import LatencyStats
@@ -61,6 +62,15 @@ class DynamicBatcher:
     queue fills, the coalescing wait shrinks linearly to zero — waiting
     optimizes latency the batcher no longer has, so under pressure it
     degrades to dispatch-as-fast-as-the-device-drains.
+
+    Multi-tenant quotas (docs/OVERLOAD.md §Priority classes): with a
+    tenant table, each queued item is charged to its ambient tenant
+    (cluster/tenant.py) against share x max_queue. A tenant at quota
+    sheds typed (``quota="over_quota"``); a *full* queue first tries to
+    displace a queued low-priority-and-over-quota item in favor of a
+    high-priority within-quota submit — brownout ordering is
+    low-priority-and-over-quota first, never cross-tenant eviction of
+    within-quota work.
     """
 
     def __init__(
@@ -72,6 +82,7 @@ class DynamicBatcher:
         max_queue: int = 0,
         metrics=None,
         flight=None,
+        tenants=None,
     ):
         # _predict is set FIRST: __getattr__ delegates to it, and any
         # attribute probe before it exists would recurse.
@@ -89,8 +100,14 @@ class DynamicBatcher:
         # One Condition owns all batcher state; its internal lock is only
         # ever held for list surgery — the device dispatch runs outside it.
         self._cv = threading.Condition()
-        self._queue: list[tuple[str, concurrent.futures.Future]] = []
+        self._queue: list[tuple[str, concurrent.futures.Future, str]] = []
         self._closed = False
+        # Per-tenant queue-token quotas (cluster/tenant.py): enforced only
+        # when the queue is bounded — an unbounded queue has no capacity to
+        # derive shares from (the pre-overload legacy configuration).
+        self.ledger = tenant_mod.TenantLedger(
+            tenants if self.max_queue > 0 else None, self.max_queue
+        )
         self.requests = 0    # items ever submitted
         self.dispatches = 0  # device-shaped batches sent to the backend
         self.sheds = 0       # submits refused at the bounded queue
@@ -101,27 +118,73 @@ class DynamicBatcher:
 
     # ---- request side ---------------------------------------------------
 
+    def _count_shed(self, tenant: str, verdict: str) -> None:
+        self.sheds += 1
+        self.ledger.note_shed(tenant)
+        if self.metrics is not None:
+            self.metrics.inc("shed")
+            self.metrics.inc("shed_microbatch")
+            if verdict == "over_quota":
+                self.metrics.inc("shed_over_quota_microbatch")
+        if self.flight is not None:
+            self.flight.note("shed", gate=self._thread.name,
+                             active=len(self._queue), tenant=tenant,
+                             quota=verdict)
+
+    def _displace_over_quota(self) -> bool:
+        """Brownout ordering under a full queue: shed the NEWEST queued
+        item whose tenant is low-priority and over quota, freeing its slot
+        for a high-priority within-quota submit. Called under the cv.
+        Returns False when every queued item is within-quota or
+        high-priority (those are never displaced across tenants)."""
+        for i in range(len(self._queue) - 1, -1, -1):
+            _, vfut, vtenant = self._queue[i]
+            if self.ledger.over_quota(vtenant) and \
+                    not self.ledger.spec(vtenant).high_priority:
+                del self._queue[i]
+                self.ledger.release(vtenant)
+                self._count_shed(vtenant, "over_quota")
+                vfut.set_exception(Overloaded(
+                    f"microbatch: displaced by higher-priority work "
+                    f"(tenant {vtenant!r} over quota)",
+                    retry_after_s=self.max_wait_s,
+                    tenant=vtenant, quota="over_quota",
+                ))
+                return True
+        return False
+
     def submit(self, synset: str) -> "concurrent.futures.Future":
         """Queue one classify request; the future resolves to its predicted
-        class index once the batch it rides in completes. Sheds with
-        ``Overloaded`` when the bounded queue is full."""
+        class index once the batch it rides in completes. Sheds with a
+        typed ``Overloaded`` (carrying the tenant + quota verdict) when the
+        bounded queue — or the calling tenant's quota — is full."""
         fut: concurrent.futures.Future = concurrent.futures.Future()
+        tenant = tenant_mod.current()
         with self._cv:
             if self._closed:
                 raise RuntimeError("batcher is stopped")
-            if self.max_queue > 0 and len(self._queue) >= self.max_queue:
-                self.sheds += 1
-                if self.metrics is not None:
-                    self.metrics.inc("shed")
-                    self.metrics.inc("shed_microbatch")
-                if self.flight is not None:
-                    self.flight.note("shed", gate=self._thread.name,
-                                     active=len(self._queue))
+            if self.ledger.would_exceed(tenant):
+                self._count_shed(tenant, "over_quota")
                 raise Overloaded(
-                    f"microbatch queue full ({len(self._queue)}/{self.max_queue})",
+                    f"microbatch: tenant {tenant!r} at quota "
+                    f"({self.ledger.active(tenant)}/{self.ledger.quota(tenant)})",
                     retry_after_s=self.max_wait_s,
+                    tenant=tenant, quota="over_quota",
                 )
-            self._queue.append((synset, fut))
+            if self.max_queue > 0 and len(self._queue) >= self.max_queue:
+                displaced = (
+                    self.ledger.spec(tenant).high_priority
+                    and self._displace_over_quota()
+                )
+                if not displaced:
+                    self._count_shed(tenant, "gate_full")
+                    raise Overloaded(
+                        f"microbatch queue full ({len(self._queue)}/{self.max_queue})",
+                        retry_after_s=self.max_wait_s,
+                        tenant=tenant, quota="gate_full",
+                    )
+            self._queue.append((synset, fut, tenant))
+            self.ledger.acquire(tenant)
             self.requests += 1
             if len(self._queue) > self.queue_hw:
                 self.queue_hw = len(self._queue)
@@ -169,10 +232,12 @@ class DynamicBatcher:
                     self._cv.wait(timeout=left)
                 batch = self._queue[: self.batch_size]
                 del self._queue[: self.batch_size]
+                for _, _, t in batch:
+                    self.ledger.release(t)
             self._dispatch(batch)
 
     def _dispatch(self, batch: list) -> None:
-        synsets = [s for s, _ in batch]
+        synsets = [s for s, _, _ in batch]
         try:
             with tracer.span("scheduler/microbatch", n=len(synsets)):
                 preds = list(self._predict(synsets))
@@ -182,13 +247,13 @@ class DynamicBatcher:
                     f"{len(synsets)} queries"
                 )
         except BaseException as e:  # noqa: BLE001 - every waiter must observe the failure
-            for _, fut in batch:
+            for _, fut, _ in batch:
                 fut.set_exception(e)
             return
         with self._cv:
             self.dispatches += 1
             self.fill.record(len(batch) / self.batch_size)
-        for (_, fut), pred in zip(batch, preds):
+        for (_, fut, _), pred in zip(batch, preds):
             fut.set_result(int(pred))
 
     def stop(self, timeout_s: float = 10.0) -> None:
@@ -204,13 +269,17 @@ class DynamicBatcher:
         dispatches, and the mean batch-fill fraction (1.0 = every dispatch
         rode a full device batch)."""
         with self._cv:
-            return {
+            out: dict = {
                 "requests": self.requests,
                 "dispatches": self.dispatches,
                 "mean_fill": self.fill.mean if len(self.fill) else 0.0,
                 "sheds": self.sheds,
                 "queue_hw": self.queue_hw,
             }
+            tenants = self.ledger.summary()
+            if tenants:
+                out["tenants"] = tenants
+            return out
 
 
 def _resolve_paths(image_source, data_dir: Path, synsets: Sequence[str]) -> list[Path]:

@@ -116,6 +116,42 @@ class ClusterConfig:
     gray_factor: float = 3.0
     gray_min_latency_s: float = 0.25
     gray_probe_interval_s: float = 5.0
+    # Tenant declarations (cluster/tenant.py, docs/OVERLOAD.md §Priority
+    # classes): {name: {"priority": "high"|"low", "share": 0..1}}. Each
+    # bounded surface (admission gates, microbatcher queue, generate slot
+    # table) derives per-tenant token quotas as share x its capacity, and
+    # shed/brownout/evict ordering is low-priority-and-over-quota first.
+    # Empty = single-tenant fleet, no quota enforcement (requests without
+    # a tenant ride as tenant "default" either way).
+    tenants: dict = field(default_factory=dict)
+    # Bound on distinct tenant labels the metrics plane will track
+    # (utils/metrics.TenantLabelGuard): past this, per-tenant series fold
+    # into tenant="other" and metrics_label_overflow counts the folds — a
+    # tenant-id flood cannot OOM the registry or the scrape tree.
+    metrics_max_tenants: int = 16
+
+    # --- elastic autoscaler (scheduler/autoscaler.py) -------------------
+    # Burn-rate-driven actuator on the leader: grows/shrinks decode-tier
+    # fan-out, generate slot/page budgets, and per-model replica targets
+    # from SLO burn + cost lanes + HBM headroom. Decisions are hysteretic
+    # (scale up on fast burn, down only after a sustained clear), bounded
+    # by a per-window moves budget, and every one is flight-recorded with
+    # its trigger + signal values.
+    autoscaler_enabled: bool = False
+    # Consecutive clear evaluations required before any scale-down (the
+    # down-hysteresis; scale-up reacts on the first fast-burn edge).
+    autoscaler_clear_windows: int = 3
+    # Max actuation moves per evaluate() call across all targets.
+    autoscaler_moves_budget: int = 2
+    # Seconds between autoscaler evaluations (rides the obs scrape loop;
+    # 0 = every scrape cycle).
+    autoscaler_interval_s: float = 0.0
+    # Refuse scale-ups that would push device HBM usage above this
+    # fraction of the limit (headroom guard; 0 disables the check).
+    autoscaler_hbm_ceiling: float = 0.9
+    # Replica bounds for per-model replica targets.
+    autoscaler_min_replicas: int = 1
+    autoscaler_max_replicas: int = 8
 
     # --- live cost profiles / SLO / placement (docs/OBSERVABILITY.md §5) ---
     # Rolling profile windows (cluster/profile.py): per-(model x member x

@@ -57,6 +57,49 @@ class Counters:
             return out
 
 
+class TenantLabelGuard:
+    """Label-cardinality bound for per-tenant metric series.
+
+    Every per-tenant gauge/counter/lane name passes its tenant through
+    ``label()`` first: the first ``max_tenants`` distinct tenants keep
+    their own label, everything after folds into ``tenant="other"`` and
+    increments the ``metrics_label_overflow`` counter — so a caller
+    flooding the fleet with fresh tenant ids can inflate ONE bucket, not
+    the registry, the scrape-tree payloads, or the Prometheus exposition
+    (docs/OBSERVABILITY.md). Admission *quota* accounting deliberately
+    does NOT ride this guard (cluster/tenant.TenantLedger keys on the
+    real name — quotas must bind to the actual tenant); only the metrics
+    plane folds. ``max_tenants <= 0`` disables the bound."""
+
+    OTHER = "other"
+
+    def __init__(self, max_tenants: int = 16, counters: Counters | None = None):
+        self.max_tenants = int(max_tenants)
+        self.counters = counters
+        self._lock = threading.Lock()
+        self._seen: set[str] = set()
+        self.overflows = 0
+
+    def label(self, tenant: str) -> str:
+        """The bounded metrics label for ``tenant`` (sticky: a tenant that
+        ever passed keeps passing; one that ever folded keeps folding)."""
+        with self._lock:
+            if tenant in self._seen or self.max_tenants <= 0:
+                self._seen.add(tenant)
+                return tenant
+            if len(self._seen) < self.max_tenants:
+                self._seen.add(tenant)
+                return tenant
+            self.overflows += 1
+            if self.counters is not None:
+                self.counters.inc("metrics_label_overflow")
+            return self.OTHER
+
+    def tracked(self) -> list[str]:
+        with self._lock:
+            return sorted(self._seen)
+
+
 class LatencyStats:
     """Streaming duration collector (seconds) with percentile summary."""
 

@@ -252,10 +252,19 @@ def test_membership_single_walk_converges():
     assert run.violation is None, run.violation
 
 
+def test_tenant_quota_bounded_exploration_is_clean():
+    # A bounded slice of the tenant-quota admission tree (the CI leg runs
+    # the exhaustive version): no admit-while-over-quota, truthful typed
+    # verdicts, balanced books — under every explored reordering.
+    result = explore(scenarios.get("tenant_quota"), max_schedules=2000)
+    assert result.findings == [], result.findings
+    assert result.schedules >= 2000  # the tree is genuinely explored
+
+
 def test_registry_names():
     assert set(scenarios.names()) >= {
         "breaker", "generate_ack", "generate_ack_buggy",
-        "membership_converge", "sdfs_put_crash_heal",
+        "membership_converge", "sdfs_put_crash_heal", "tenant_quota",
     }
 
 

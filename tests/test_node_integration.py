@@ -167,6 +167,53 @@ def test_status_verb_shows_shed_requests(cluster3):
     assert member.metrics.get("shed") == 1
 
 
+def test_tenants_verb_renders_quota_plane(tmp_path):
+    """The CLI `tenants` verb (and `status`) surface the tenant plane on a
+    real cluster: declared priorities/shares, live gate occupancy and debt,
+    typed over-quota sheds, and the autoscaler's targets (docs/OPERATIONS.md
+    §Tenants and the autoscaler)."""
+    from dmlc_tpu.cluster import tenant as tenant_mod
+    from dmlc_tpu.cluster.rpc import Overloaded
+
+    nodes = start_local_cluster(
+        tmp_path, n_nodes=2,
+        tenants={"acme": {"priority": "low", "share": 0.25}},
+        autoscaler_enabled=True,
+    )
+    try:
+        member = nodes[1]
+        cli = Cli(member)
+        gate = member.predict_gate
+        quota = gate.ledger.quota("acme")
+        holders = []
+        with tenant_mod.bind("acme"):
+            for _ in range(quota):
+                ctx = gate.admit()
+                ctx.__enter__()
+                holders.append(ctx)
+            # One past the share: typed over_quota, visible in both verbs.
+            with pytest.raises(Overloaded) as ei:
+                gate.admit().__enter__()
+            assert ei.value.quota == "over_quota"
+        try:
+            out = cli.run_command("tenants")
+            assert "acme" in out and "low" in out, out
+            assert f"{quota}/{quota}" in out, out  # occupancy at quota
+            assert "over-quota sheds" in out, out
+            assert "autoscaler targets" in out, out
+            status = cli.run_command("status")
+            assert "tenant acme:" in status, status
+            assert "over_quota_sheds=1" in status, status
+            assert "autoscaler:" in status, status
+        finally:
+            for h in holders:
+                h.__exit__(None, None, None)
+        # The leader renders the same plane from its own seat.
+        assert "acme" in Cli(nodes[0]).run_command("tenants")
+    finally:
+        stop_local_cluster(nodes)
+
+
 def test_leader_failover_resumes_jobs(cluster3, tmp_path):
     nodes = cluster3
     leader, standby, member = nodes

@@ -1,0 +1,327 @@
+"""Tenant identity + quota-edge suite (docs/OVERLOAD.md §Priority classes).
+
+Pins the tentpole's admission contracts at their edges:
+
+- the ambient tenant context (cluster/tenant.py) mirrors tracectx: bind /
+  clear semantics, wire form omitted for the default tenant;
+- a mixed-version fleet keeps working: legacy frames carry no ``n`` field
+  and read as the default tenant at full share;
+- AT quota admits, one past quota sheds *typed* (``quota="over_quota"``,
+  tenant named) while the gate still has room; a full gate sheds
+  ``gate_full``; and the microbatch displacement ordering is
+  low-priority-and-over-quota first, never within-quota work.
+
+CI runs this file inside the chaos seed matrix (tools/ci_check.sh).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from dmlc_tpu.cluster import tenant as tenant_mod
+from dmlc_tpu.cluster.admission import AdmissionGate
+from dmlc_tpu.cluster.rpc import Overloaded, SimRpcNetwork
+from dmlc_tpu.scheduler.worker import DynamicBatcher
+from dmlc_tpu.utils.metrics import Counters
+
+
+def specs(**kw):
+    """{'acme': ('low', 0.2)} -> parsed TenantSpec table."""
+    return tenant_mod.parse_tenants(
+        {name: {"priority": p, "share": s} for name, (p, s) in kw.items()}
+    )
+
+
+# ---------------------------------------------------------------------------
+# Ambient context + wire form
+# ---------------------------------------------------------------------------
+
+
+class TestAmbientTenant:
+    def test_default_when_unbound(self):
+        assert tenant_mod.current() == tenant_mod.DEFAULT_TENANT
+        assert tenant_mod.wire_context() is None
+
+    def test_bind_nests_and_restores(self):
+        with tenant_mod.bind("acme") as t:
+            assert t == "acme"
+            assert tenant_mod.current() == "acme"
+            assert tenant_mod.wire_context() == "acme"
+            with tenant_mod.bind("beta"):
+                assert tenant_mod.current() == "beta"
+            assert tenant_mod.current() == "acme"
+        assert tenant_mod.current() == tenant_mod.DEFAULT_TENANT
+
+    def test_bind_none_clears_inherited_tenant(self):
+        # The server binds None for frames without an `n` field; that must
+        # CLEAR any tenant inherited on the dispatching stack (the sim
+        # fabric dispatches on the caller's stack).
+        with tenant_mod.bind("acme"), tenant_mod.bind(None):
+            assert tenant_mod.current() == tenant_mod.DEFAULT_TENANT
+            assert tenant_mod.wire_context() is None
+
+    def test_default_tenant_rides_wireless(self):
+        with tenant_mod.bind(tenant_mod.DEFAULT_TENANT):
+            assert tenant_mod.wire_context() is None
+
+    def test_from_wire_tolerates_garbage(self):
+        assert tenant_mod.from_wire(None) is None
+        assert tenant_mod.from_wire("") is None
+        assert tenant_mod.from_wire(42) is None
+        assert tenant_mod.from_wire(["acme"]) is None
+        assert tenant_mod.from_wire("acme") == "acme"
+
+    def test_parse_tenants_validates(self):
+        with pytest.raises(ValueError):
+            tenant_mod.parse_tenants({"a": {"priority": "urgent"}})
+        with pytest.raises(ValueError):
+            tenant_mod.parse_tenants({"a": {"share": 0.0}})
+        with pytest.raises(ValueError):
+            tenant_mod.parse_tenants({"a": "half"})
+        table = tenant_mod.parse_tenants({"a": {}})
+        assert table["a"].high_priority and table["a"].share == 1.0
+
+    def test_spec_for_standing(self):
+        table = specs(acme=("low", 0.25))
+        assert tenant_mod.spec_for("acme", table).share == 0.25
+        default = tenant_mod.spec_for(tenant_mod.DEFAULT_TENANT, table)
+        assert default.high_priority and default.share == 1.0
+        unknown = tenant_mod.spec_for("never-declared", table)
+        assert not unknown.high_priority
+        assert unknown.share == tenant_mod.UNKNOWN_SHARE
+
+    def test_quota_floors_at_one_and_caps_at_capacity(self):
+        tiny = tenant_mod.TenantSpec(name="t", share=0.01)
+        assert tenant_mod.quota_of(tiny, 8) == 1
+        full = tenant_mod.TenantSpec(name="t", share=1.0)
+        assert tenant_mod.quota_of(full, 8) == 8
+        assert tenant_mod.quota_of(full, 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# Wire threading: the `n` field across the fabric, and legacy frames
+# ---------------------------------------------------------------------------
+
+
+class TestTenantOnTheWire:
+    def _serve_echo(self, net: SimRpcNetwork) -> None:
+        net.serve("srv:1", {"job.echo": lambda p: {"tenant": tenant_mod.current()}})
+
+    def test_frame_carries_n_and_server_rebinds(self):
+        net = SimRpcNetwork()
+        self._serve_echo(net)
+        client = net.client("cli:0")
+        with tenant_mod.bind("acme"):
+            reply = client.call("srv:1", "job.echo", {})
+        assert reply["tenant"] == "acme"
+        assert net.frames[-1]["n"] == "acme"
+
+    def test_default_tenant_frames_are_byte_identical_legacy(self):
+        # No tenant bound -> no `n` field at all: tenancy disabled costs
+        # zero frame bytes and old peers never see a new field.
+        net = SimRpcNetwork()
+        self._serve_echo(net)
+        reply = net.client("cli:0").call("srv:1", "job.echo", {})
+        assert reply["tenant"] == tenant_mod.DEFAULT_TENANT
+        assert "n" not in net.frames[-1]
+
+    def test_legacy_frame_without_n_on_mixed_version_fleet(self):
+        # A pre-tenancy peer's frame never carries `n`; the new server must
+        # read it as the default tenant at full share, not refuse it.
+        from dmlc_tpu.cluster.rpc import serve_with_deadline
+
+        seen = {}
+
+        def method(p):
+            seen["tenant"] = tenant_mod.current()
+            return {"ok": True}
+
+        serve_with_deadline({"job.x": method}, "job.x", {}, 5.0,
+                            clock=time.monotonic)
+        assert seen["tenant"] == tenant_mod.DEFAULT_TENANT
+
+        gate = AdmissionGate(2, 0, "legacy", tenants=specs(acme=("low", 0.5)))
+        with gate.admit():
+            pass  # the default tenant admits at full share on a quota fleet
+        assert gate.sheds == 0
+
+    def test_overloaded_reply_carries_tenant_and_verdict(self):
+        net = SimRpcNetwork()
+        gate = AdmissionGate(4, 0, "door", tenants=specs(acme=("low", 0.25)))
+
+        def congested(p):
+            with gate.admit():
+                return {}
+
+        net.serve("srv:1", {"job.x": congested})
+        client = net.client("cli:0")
+        with tenant_mod.bind("acme"):
+            with gate.admit():  # acme holds its whole quota (1 of 4 slots)
+                with pytest.raises(Overloaded) as e:
+                    client.call("srv:1", "job.x", {})
+        # The typed verdict survives the remote-error round trip.
+        assert e.value.tenant == "acme"
+        assert e.value.quota == "over_quota"
+
+
+# ---------------------------------------------------------------------------
+# AdmissionGate quota edges
+# ---------------------------------------------------------------------------
+
+
+class TestGateQuotaEdges:
+    def test_at_quota_admits_one_past_sheds_typed(self):
+        # capacity 4, share 0.5 -> quota 2: both quota tokens must admit,
+        # the third shed must be typed over_quota with the gate NOT full.
+        metrics = Counters()
+        gate = AdmissionGate(
+            4, 0, "predict", metrics=metrics, tenants=specs(acme=("low", 0.5))
+        )
+        with tenant_mod.bind("acme"):
+            with gate.admit(), gate.admit():
+                assert gate.ledger.active("acme") == gate.ledger.quota("acme") == 2
+                with pytest.raises(Overloaded) as e:
+                    with gate.admit():
+                        pass
+        assert e.value.quota == "over_quota"
+        assert e.value.tenant == "acme"
+        assert e.value.retry_after_s is not None
+        assert gate.active == 0  # releases balanced
+        assert metrics.get("shed_over_quota_predict") == 1
+
+    def test_surge_exhausts_own_quota_not_the_door(self):
+        # acme at quota must not stop the default tenant: the door still
+        # has tokens and the default tenant's share is the full capacity.
+        gate = AdmissionGate(4, 0, "predict", tenants=specs(acme=("low", 0.25)))
+        with tenant_mod.bind("acme"):
+            ctx = gate.admit()
+            ctx.__enter__()
+            with pytest.raises(Overloaded):
+                with gate.admit():
+                    pass
+        try:
+            with gate.admit():  # default tenant sails through
+                pass
+        finally:
+            with tenant_mod.bind("acme"):
+                ctx.__exit__(None, None, None)
+
+    def test_gate_full_verdict_when_capacity_exhausted(self):
+        gate = AdmissionGate(1, 0, "predict", tenants=specs(acme=("high", 1.0)))
+        with gate.admit():
+            with tenant_mod.bind("acme"):
+                with pytest.raises(Overloaded) as e:
+                    with gate.admit():
+                        pass
+        assert e.value.quota == "gate_full"
+        assert e.value.tenant == "acme"
+
+    def test_unknown_tenant_rides_the_residual_share(self):
+        # An undeclared name gets UNKNOWN_SHARE, not a blackhole: with
+        # capacity 10 that is one token — admitted — and the second sheds.
+        gate = AdmissionGate(10, 0, "predict", tenants=specs(acme=("low", 0.5)))
+        with tenant_mod.bind("who-is-this"):
+            with gate.admit():
+                with pytest.raises(Overloaded) as e:
+                    with gate.admit():
+                        pass
+        assert e.value.quota == "over_quota"
+        assert gate.ledger.summary()["who-is-this"]["over_quota_sheds"] == 1
+
+    def test_no_tenants_configured_is_legacy(self):
+        gate = AdmissionGate(2, 0, "predict")
+        assert not gate.ledger.enforcing
+        with tenant_mod.bind("acme"):
+            with gate.admit():
+                pass  # accounting only, no quota refusals possible
+        assert gate.sheds == 0
+        assert gate.ledger.quota("acme") == gate.capacity
+
+
+# ---------------------------------------------------------------------------
+# DynamicBatcher quota edges + displacement ordering
+# ---------------------------------------------------------------------------
+
+
+class TestBatcherQuotaEdges:
+    def _blocked_batcher(self, release: threading.Event, **kw) -> DynamicBatcher:
+        def predict(synsets):
+            release.wait(timeout=10.0)
+            return [0] * len(synsets)
+
+        return DynamicBatcher(predict, batch_size=4, max_wait_s=0.005,
+                              max_queue=8, **kw)
+
+    @staticmethod
+    def _drain_first_batch(b: DynamicBatcher) -> None:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with b._cv:
+                if not b._queue:
+                    return
+            time.sleep(0.001)
+        raise AssertionError("worker never picked up the priming batch")
+
+    def test_quota_edge_and_displacement_ordering(self):
+        release = threading.Event()
+        b = self._blocked_batcher(
+            release, tenants=specs(acme=("low", 0.2), beta=("high", 1.0))
+        )
+        try:
+            # Prime: one full batch rides into the blocked backend, so the
+            # queue state below is frozen and deterministic.
+            primed = [b.submit(f"p{i}") for i in range(4)]
+            self._drain_first_batch(b)
+
+            # quota(acme) = max(1, int(0.2 * 8)) = 1: AT quota admits...
+            with tenant_mod.bind("acme"):
+                acme_fut = b.submit("acme0")
+                # ... one past quota sheds typed, queue NOT full (1/8).
+                with pytest.raises(Overloaded) as e:
+                    b.submit("acme1")
+            assert e.value.quota == "over_quota"
+            assert e.value.tenant == "acme"
+
+            # Fill the bounded queue with default work: 7 more -> 8/8.
+            filler = [b.submit(f"f{i}") for i in range(7)]
+            # Full queue + every resident within quota: a high-priority
+            # submit must NOT displace within-quota work — typed gate_full.
+            with tenant_mod.bind("beta"):
+                with pytest.raises(Overloaded) as e:
+                    b.submit("beta0")
+            assert e.value.quota == "gate_full"
+
+            # Push acme over quota (a shrunken share mid-flight), then the
+            # same high-priority submit displaces acme's NEWEST queued item
+            # — low-priority-and-over-quota first, never the default work.
+            b.ledger.acquire("acme")
+            with tenant_mod.bind("beta"):
+                beta_fut = b.submit("beta1")
+            with pytest.raises(Overloaded) as displaced:
+                acme_fut.result(timeout=5.0)
+            assert displaced.value.quota == "over_quota"
+            assert displaced.value.tenant == "acme"
+
+            release.set()
+            assert [f.result(timeout=10.0) for f in primed] == [0] * 4
+            assert [f.result(timeout=10.0) for f in filler] == [0] * 7
+            assert beta_fut.result(timeout=10.0) == 0
+            tenants = b.summary()["tenants"]
+            assert tenants["acme"]["over_quota_sheds"] == 2
+        finally:
+            release.set()
+            b.stop()
+
+    def test_batcher_without_bound_never_enforces(self):
+        release = threading.Event()
+        release.set()
+        b = DynamicBatcher(lambda s: [0] * len(s), batch_size=2)
+        try:
+            with tenant_mod.bind("acme"):
+                assert b.submit("x").result(timeout=5.0) == 0
+            assert not b.ledger.enforcing
+        finally:
+            b.stop()

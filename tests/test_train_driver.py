@@ -1,118 +1,175 @@
 """Checkpointed training in anger: dp x tp steps with replicated SDFS
 checkpoints, leader killed mid-training, training resumed from the
-checkpoint served by the promoted standby (VERDICT r1 item 9)."""
+checkpoint served by the promoted standby (VERDICT r1 item 9).
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+Runs under a subprocess isolation wrapper (same pattern as the pjrt probe
+and multihost tests): the XLA CPU client occasionally aborts the whole
+interpreter when this module's 4x2 mesh work lands in a process that
+already ran other backend-touching suites, and an abort in-process takes
+the entire tier-1 collector down with it. Each wrapper re-runs its test in
+a FRESH interpreter (clean backend state — which is also what makes the
+abort stop reproducing) and retries once if the child dies on a signal.
+Tracking note: docs/OPERATIONS.md §Known test isolation quirks.
+"""
 
-from dmlc_tpu.cluster.failover import StandbyLeader
-from dmlc_tpu.cluster.rpc import SimRpcNetwork
-from dmlc_tpu.cluster.sdfs import MemberStore, SdfsClient, SdfsLeader, SdfsMember
-from dmlc_tpu.models.vit import ViT
-from dmlc_tpu.parallel import mesh as mesh_lib
-from dmlc_tpu.parallel import train as train_lib
-from dmlc_tpu.parallel.trainer import TrainingDriver
-from dmlc_tpu.scheduler.jobs import JobScheduler
-from dmlc_tpu.utils.checkpoint import SdfsCheckpointer
+import os
+import subprocess
+import sys
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-def fresh_state():
-    model = ViT(
-        num_classes=8, patch_size=8, hidden_size=32, num_layers=1,
-        num_heads=2, mlp_dim=64, dtype=jnp.float32,
-    )
-    variables = model.init(
-        jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3), jnp.float32), train=False
-    )
-    return train_lib.create_train_state(model, variables, train_lib.default_optimizer(1e-3))
+# The wrapper sets this before re-invoking pytest on this file in a child
+# process; the child defines the real tests, the parent defines wrappers
+# under the SAME names so node ids select the right layer in each mode.
+_INNER = os.environ.get("DMLC_TRAIN_DRIVER_INNER") == "1"
 
 
-def data_fn(step: int):
-    rng = np.random.RandomState(step)
-    images = rng.randn(8, 16, 16, 3).astype(np.float32)
-    labels = rng.randint(0, 8, size=(8,))
-    return images, labels
+if _INNER:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dmlc_tpu.cluster.failover import StandbyLeader
+    from dmlc_tpu.cluster.rpc import SimRpcNetwork
+    from dmlc_tpu.cluster.sdfs import MemberStore, SdfsClient, SdfsLeader, SdfsMember
+    from dmlc_tpu.models.vit import ViT
+    from dmlc_tpu.parallel import mesh as mesh_lib
+    from dmlc_tpu.parallel import train as train_lib
+    from dmlc_tpu.parallel.trainer import TrainingDriver
+    from dmlc_tpu.scheduler.jobs import JobScheduler
+    from dmlc_tpu.utils.checkpoint import SdfsCheckpointer
+
+    def fresh_state():
+        model = ViT(
+            num_classes=8, patch_size=8, hidden_size=32, num_layers=1,
+            num_heads=2, mlp_dim=64, dtype=jnp.float32,
+        )
+        variables = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3), jnp.float32), train=False
+        )
+        return train_lib.create_train_state(
+            model, variables, train_lib.default_optimizer(1e-3)
+        )
+
+    def data_fn(step: int):
+        rng = np.random.RandomState(step)
+        images = rng.randn(8, 16, 16, 3).astype(np.float32)
+        labels = rng.randint(0, 8, size=(8,))
+        return images, labels
+
+    def host_tree(tree):
+        return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+    def test_driver_checkpoints_and_survives_leader_kill(tmp_path):
+        net = SimRpcNetwork()
+        live = ["m0", "m1", "m2"]
+        stores = {}
+        for m in live:
+            stores[m] = MemberStore(tmp_path / m)
+            net.serve(m, SdfsMember(stores[m], net.client(m)).methods())
+
+        # Primary (L0, actively leading) + standby (L1) with directory sync.
+        primary_sdfs = SdfsLeader(
+            net.client("L0"), lambda: list(live), replication_factor=2
+        )
+        primary_jobs = JobScheduler(net.client("L0"), lambda: list(live), jobs={})
+        primary_jobs.is_leading = True
+        net.serve("L0", {**primary_sdfs.methods(), **primary_jobs.methods()})
+        standby_sdfs = SdfsLeader(
+            net.client("L1"), lambda: list(live), replication_factor=2,
+            is_leading=False,
+        )
+        standby_jobs = JobScheduler(net.client("L1"), lambda: list(live), jobs={})
+        net.serve("L1", {**standby_sdfs.methods(), **standby_jobs.methods()})
+        monitor = StandbyLeader(
+            net.client("L1"), "L1", ["L0", "L1"], standby_jobs,
+            sdfs_leader=standby_sdfs,
+        )
+
+        mesh = mesh_lib.make_mesh({"dp": 4, "tp": 2})
+
+        # --- phase 1: train with periodic replicated checkpoints ---------
+        client0 = SdfsClient(net.client("m0"), "L0", stores["m0"], "m0")
+        driver1 = TrainingDriver(
+            mesh,
+            fresh_state(),
+            data_fn,
+            checkpointer=SdfsCheckpointer(client0),
+            checkpoint_every=2,
+        )
+        assert driver1.start_step == 0  # nothing to restore yet
+        driver1.run(3)  # checkpoints at step 2 and (final) step 3
+        assert [h["step"] for h in driver1.history] == [1, 2, 3]
+        params_after_3 = host_tree(driver1.state.params)
+
+        monitor.step()  # standby mirrors the directory (checkpoint versions)
+        assert standby_sdfs.state.latest_version("checkpoints/train_state") == 2
+
+        # --- leader dies mid-training ------------------------------------
+        net.crash("L0")
+        monitor.step()
+        assert monitor.is_leader  # promoted; SDFS writes now accepted at L1
+
+        # --- phase 2: a NEW driver on the new leader restores + continues
+        client1 = SdfsClient(net.client("m1"), "L1", stores["m1"], "m1")
+        driver2 = TrainingDriver(
+            mesh,
+            fresh_state(),
+            data_fn,
+            checkpointer=SdfsCheckpointer(client1),
+            checkpoint_every=2,
+        )
+        assert driver2.start_step == 3  # restored from the replicated checkpoint
+        restored_params = host_tree(driver2.state.params)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+            restored_params,
+            params_after_3,
+        )
+
+        last = driver2.run(2)  # steps 4, 5 — checkpointed through the NEW leader
+        assert [h["step"] for h in driver2.history] == [4, 5]
+        assert int(driver2.state.step) == 5
+        assert np.isfinite(last["loss"])
+        # The post-failover checkpoint is a fresh version in the same file.
+        assert standby_sdfs.state.latest_version("checkpoints/train_state") >= 3
+
+    def test_driver_fresh_run_without_checkpointer():
+        mesh = mesh_lib.make_mesh({"dp": 8})
+        driver = TrainingDriver(mesh, fresh_state(), data_fn, checkpointer=None)
+        first = driver.run(2)
+        assert int(driver.state.step) == 2
+        assert np.isfinite(first["loss"]) and 0.0 <= first["accuracy"] <= 1.0
 
 
-def host_tree(tree):
-    return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
+else:
 
+    def _run_isolated(test_name: str) -> None:
+        env = dict(os.environ)
+        env["DMLC_TRAIN_DRIVER_INNER"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        cmd = [
+            sys.executable, "-m", "pytest",
+            f"{os.path.abspath(__file__)}::{test_name}",
+            "-q", "-p", "no:cacheprovider",
+        ]
+        for attempt in (1, 2):
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, env=env,
+                cwd=REPO_ROOT, timeout=600,
+            )
+            if proc.returncode == 0:
+                return
+            if proc.returncode < 0 and attempt == 1:
+                continue  # child died on a signal: one fresh-interpreter retry
+            raise AssertionError(
+                f"{test_name} failed in isolation (rc={proc.returncode}):\n"
+                f"{proc.stdout[-3000:]}\n{proc.stderr[-2000:]}"
+            )
 
-def test_driver_checkpoints_and_survives_leader_kill(tmp_path):
-    net = SimRpcNetwork()
-    live = ["m0", "m1", "m2"]
-    stores = {}
-    for m in live:
-        stores[m] = MemberStore(tmp_path / m)
-        net.serve(m, SdfsMember(stores[m], net.client(m)).methods())
+    def test_driver_checkpoints_and_survives_leader_kill():
+        _run_isolated("test_driver_checkpoints_and_survives_leader_kill")
 
-    # Primary (L0, actively leading) + standby (L1) with directory sync.
-    primary_sdfs = SdfsLeader(net.client("L0"), lambda: list(live), replication_factor=2)
-    primary_jobs = JobScheduler(net.client("L0"), lambda: list(live), jobs={})
-    primary_jobs.is_leading = True
-    net.serve("L0", {**primary_sdfs.methods(), **primary_jobs.methods()})
-    standby_sdfs = SdfsLeader(
-        net.client("L1"), lambda: list(live), replication_factor=2, is_leading=False
-    )
-    standby_jobs = JobScheduler(net.client("L1"), lambda: list(live), jobs={})
-    net.serve("L1", {**standby_sdfs.methods(), **standby_jobs.methods()})
-    monitor = StandbyLeader(
-        net.client("L1"), "L1", ["L0", "L1"], standby_jobs, sdfs_leader=standby_sdfs
-    )
-
-    mesh = mesh_lib.make_mesh({"dp": 4, "tp": 2})
-
-    # --- phase 1: train with periodic replicated checkpoints -------------
-    client0 = SdfsClient(net.client("m0"), "L0", stores["m0"], "m0")
-    driver1 = TrainingDriver(
-        mesh,
-        fresh_state(),
-        data_fn,
-        checkpointer=SdfsCheckpointer(client0),
-        checkpoint_every=2,
-    )
-    assert driver1.start_step == 0  # nothing to restore yet
-    driver1.run(3)  # checkpoints at step 2 and (final) step 3
-    assert [h["step"] for h in driver1.history] == [1, 2, 3]
-    params_after_3 = host_tree(driver1.state.params)
-
-    monitor.step()  # standby mirrors the directory (checkpoint versions)
-    assert standby_sdfs.state.latest_version("checkpoints/train_state") == 2
-
-    # --- leader dies mid-training ---------------------------------------
-    net.crash("L0")
-    monitor.step()
-    assert monitor.is_leader  # promoted; SDFS writes now accepted at L1
-
-    # --- phase 2: a NEW driver on the new leader restores + continues ----
-    client1 = SdfsClient(net.client("m1"), "L1", stores["m1"], "m1")
-    driver2 = TrainingDriver(
-        mesh,
-        fresh_state(),
-        data_fn,
-        checkpointer=SdfsCheckpointer(client1),
-        checkpoint_every=2,
-    )
-    assert driver2.start_step == 3  # restored from the replicated checkpoint
-    restored_params = host_tree(driver2.state.params)
-    jax.tree_util.tree_map(
-        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
-        restored_params,
-        params_after_3,
-    )
-
-    last = driver2.run(2)  # steps 4, 5 — checkpointed through the NEW leader
-    assert [h["step"] for h in driver2.history] == [4, 5]
-    assert int(driver2.state.step) == 5
-    assert np.isfinite(last["loss"])
-    # The post-failover checkpoint is a fresh version in the same file.
-    assert standby_sdfs.state.latest_version("checkpoints/train_state") >= 3
-
-
-def test_driver_fresh_run_without_checkpointer():
-    mesh = mesh_lib.make_mesh({"dp": 8})
-    driver = TrainingDriver(mesh, fresh_state(), data_fn, checkpointer=None)
-    first = driver.run(2)
-    assert int(driver.state.step) == 2
-    assert np.isfinite(first["loss"]) and 0.0 <= first["accuracy"] <= 1.0
+    def test_driver_fresh_run_without_checkpointer():
+        _run_isolated("test_driver_fresh_run_without_checkpointer")

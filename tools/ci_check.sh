@@ -171,6 +171,15 @@ for seed_base in 0 1000 2000; do
     note "loadgen smoke $seed_base FAILED (replay: python tools/slo_cert.py --seed $seed_base --out /tmp/slo_cert_$seed_base.json)"
     fail=1
   fi
+  note "tenant-isolation smoke DMLC_CHAOS_SEED=$seed_base (two-tenant flash-crowd replay + autoscaler convergence, docs/OVERLOAD.md)"
+  if env JAX_PLATFORMS=cpu python tools/slo_cert.py --tenants --members 6 \
+      --sample-rate 1.0 --seed "$seed_base" \
+      --out "/tmp/slo_cert_tenants_$seed_base.json"; then
+    note "tenant-isolation smoke $seed_base OK (/tmp/slo_cert_tenants_$seed_base.json)"
+  else
+    note "tenant-isolation smoke $seed_base FAILED (replay: python tools/slo_cert.py --tenants --seed $seed_base --out /tmp/slo_cert_tenants_$seed_base.json)"
+    fail=1
+  fi
   note "gang smoke DMLC_CHAOS_SEED=$seed_base (sharded predict vs mesh-of-1 reference at 3 and 8 virtual devices, docs/SHARDING.md)"
   if env DMLC_CHAOS_SEED="$seed_base" python -c \
       "import __graft_entry__ as g; g.gang_smoke(3); g.gang_smoke(8)"; then
@@ -184,7 +193,8 @@ for seed_base in 0 1000 2000; do
       tests/test_chaos.py tests/test_sdfs_faults.py tests/test_overload.py \
       tests/test_generate_cluster.py tests/test_placement.py \
       tests/test_scrapetree.py tests/test_loadgen.py \
-      tests/test_decodetier.py \
+      tests/test_decodetier.py tests/test_tenant.py \
+      tests/test_autoscaler.py \
       -q -p no:cacheprovider; then
     note "chaos leg $seed_base OK"
   else

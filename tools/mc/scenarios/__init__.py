@@ -42,4 +42,10 @@ def _load() -> None:
     if _loaded:
         return
     _loaded = True
-    from tools.mc.scenarios import breaker, generate, membership, sdfs  # noqa: F401
+    from tools.mc.scenarios import (  # noqa: F401
+        breaker,
+        generate,
+        membership,
+        quota,
+        sdfs,
+    )

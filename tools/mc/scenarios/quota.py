@@ -1,0 +1,149 @@
+"""Tenant-quota admission scenario: AdmissionGate + TenantLedger.
+
+One quota-enforcing ``AdmissionGate`` (cluster/admission.py) shared by a
+low-priority tenant, a high-priority tenant, and an undeclared name, with
+the full interleaving of concurrent admits and releases. Every event is
+dependent on every other (one shared gate + ledger), so the tree is the
+exact multiset of orderings, bounded by the per-event budgets.
+
+After every event the door's books are checked (docs/OVERLOAD.md
+§Priority classes):
+
+- ``quota-admission`` — no tenant's occupancy ever exceeds its derived
+                        quota: an admit that would cross the line must
+                        have shed typed ``over_quota`` instead, under
+                        ANY reordering of the surrounding admits and
+                        releases.
+- ``quota-verdict``   — a typed refusal tells the truth: ``over_quota``
+                        only when the caller's own share was exhausted,
+                        ``gate_full`` only when the whole door was.
+- ``gate-books``      — gate occupancy stays within capacity and equals
+                        the sum of per-tenant ledger occupancy (no token
+                        leaks across admit/shed/release).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from dmlc_tpu.cluster import tenant as tenant_mod
+from dmlc_tpu.cluster.admission import AdmissionGate
+from dmlc_tpu.cluster.rpc import Overloaded
+from tools.mc.core import Event, InvariantViolation
+from tools.mc.scenarios import register
+
+#: capacity 5 (2 inflight + 3 queue): acme's derived quota is
+#: max(1, int(0.4 * 5)) = 2, the unknown name's is 1, beta rides full.
+TENANTS = {"acme": ("low", 0.4), "beta": ("high", 1.0)}
+
+
+class _World:
+    def __init__(self) -> None:
+        self.gate = AdmissionGate(
+            2, 3, "mc",
+            tenants=tenant_mod.parse_tenants(
+                {n: {"priority": p, "share": s} for n, (p, s) in TENANTS.items()}
+            ),
+        )
+        self.held: list[tuple[str, object]] = []
+        self.budgets = {
+            "admit_acme": 3,   # one past acme's quota of 2
+            "admit_beta": 3,
+            "admit_ghost": 2,  # undeclared tenant: one past its quota of 1
+            "release": 3,
+        }
+
+    def enabled(self) -> list[Event]:
+        events = []
+        for name, tenant in (
+            ("admit_acme", "acme"),
+            ("admit_beta", "beta"),
+            ("admit_ghost", "ghost"),
+        ):
+            if self.budgets[name] > 0:
+                events.append(Event(
+                    name, lambda t=tenant, n=name: self._admit(n, t)
+                ))  # empty footprint: one shared gate, all-dependent
+        if self.budgets["release"] > 0 and self.held:
+            events.append(Event("release", self._release))
+        return events
+
+    def _admit(self, name: str, tenant: str) -> None:
+        self.budgets[name] -= 1
+        ledger = self.gate.ledger
+        at_quota = ledger.active(tenant) + 1 > ledger.quota(tenant)
+        door_full = self.gate.active >= self.gate.capacity
+        with tenant_mod.bind(tenant):
+            ctx = self.gate.admit()
+            try:
+                ctx.__enter__()
+            except Overloaded as e:
+                if e.quota == "over_quota" and not at_quota:
+                    raise InvariantViolation(
+                        "quota-verdict",
+                        f"tenant {tenant!r} shed over_quota with "
+                        f"{ledger.active(tenant)}/{ledger.quota(tenant)} "
+                        "tokens in use",
+                    )
+                if e.quota == "gate_full" and not door_full:
+                    raise InvariantViolation(
+                        "quota-verdict",
+                        f"tenant {tenant!r} shed gate_full with the door at "
+                        f"{self.gate.active}/{self.gate.capacity}",
+                    )
+                return
+        self.held.append((tenant, ctx))
+
+    def _release(self) -> None:
+        self.budgets["release"] -= 1
+        tenant, ctx = self.held.pop(0)
+        with tenant_mod.bind(tenant):
+            ctx.__exit__(None, None, None)
+
+    # ---- invariants -------------------------------------------------------
+
+    def _check(self) -> None:
+        ledger = self.gate.ledger
+        for tenant in ("acme", "beta", "ghost", tenant_mod.DEFAULT_TENANT):
+            active, quota = ledger.active(tenant), ledger.quota(tenant)
+            if active > quota:
+                raise InvariantViolation(
+                    "quota-admission",
+                    f"tenant {tenant!r} holds {active} tokens over its "
+                    f"quota of {quota}",
+                )
+        if self.gate.active > self.gate.capacity:
+            raise InvariantViolation(
+                "gate-books",
+                f"door occupancy {self.gate.active} exceeds capacity "
+                f"{self.gate.capacity}",
+            )
+        ledger_total = sum(
+            ledger.active(t)
+            for t in ("acme", "beta", "ghost", tenant_mod.DEFAULT_TENANT)
+        )
+        if ledger_total != self.gate.active:
+            raise InvariantViolation(
+                "gate-books",
+                f"ledger holds {ledger_total} tokens but the door counts "
+                f"{self.gate.active} (a shed or release leaked)",
+            )
+
+    def invariants(self) -> list[tuple[str, Callable[[], None]]]:
+        return [("quota", self._check)]
+
+    def close(self) -> None:
+        while self.held:
+            tenant, ctx = self.held.pop(0)
+            with tenant_mod.bind(tenant):
+                ctx.__exit__(None, None, None)
+
+
+class _QuotaScenario:
+    name = "tenant_quota"
+
+    def build(self) -> _World:
+        return _World()
+
+
+register(_QuotaScenario())

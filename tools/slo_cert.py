@@ -69,9 +69,49 @@ def build_parser() -> argparse.ArgumentParser:
                     help="diurnal period in s (default: 2x duration)")
     ap.add_argument("--scrape-interval", type=float, default=10.0,
                     help="leader scrape cadence in virtual s (default 10)")
+    ap.add_argument("--tenants", action="store_true",
+                    help="run the pinned two-tenant isolation scenario "
+                         "(quota enforcement + autoscaler) instead of the "
+                         "traffic-shape flags; exit 0 additionally requires "
+                         "the surging tenant shed typed over-quota, the "
+                         "steady tenant's p99 certified, zero cross-tenant "
+                         "evictions, and autoscaler convergence")
     ap.add_argument("--out", default="slo_cert.json",
                     help="certificate path (default ./slo_cert.json)")
     return ap
+
+
+def tenant_failures(doc: dict) -> list[str]:
+    """The isolation verdicts ci_check's tenant leg gates on — shared
+    with tests/test_autoscaler.py so CI and pytest pin the same story."""
+    failures: list[str] = []
+    tenants = (doc.get("tenants") or {}).get("tenants") or {}
+    surging = tenants.get("acme") or {}
+    steady = tenants.get("default") or {}
+    if not surging.get("shed_over_quota"):
+        failures.append("surging tenant never shed typed over-quota — the "
+                        "flash crowd was not quota-bounded")
+    if surging.get("shed_over_quota", 0) > surging.get("shed", 0):
+        failures.append("over-quota sheds exceed total sheds")
+    if not steady.get("certified"):
+        failures.append("steady tenant's p99 lost certification — the "
+                        "surge leaked across the quota boundary")
+    if (doc.get("tenants") or {}).get("cross_tenant_evictions") != 0:
+        failures.append("cross-tenant evictions are nonzero")
+    auto = doc.get("autoscaler") or {}
+    up_cycles = auto.get("scale_up_cycles")
+    if up_cycles is None or up_cycles > 3:
+        failures.append(f"autoscaler scale-up took {up_cycles} fast-burn "
+                        "cycles (want <= 3)")
+    if not auto.get("scaled_down"):
+        failures.append("autoscaler never scaled back down after the surge")
+    if auto.get("breach_after_scale_down"):
+        failures.append("SLO burned again after the scale-down — the "
+                        "shrink re-triggered the overload it cleared")
+    if auto.get("flight_recorded", 0) < len(auto.get("decisions") or ()):
+        failures.append("autoscaler decisions missing from the flight "
+                        "recorder")
+    return failures
 
 
 def main(argv=None) -> int:
@@ -83,25 +123,34 @@ def main(argv=None) -> int:
     )
 
     args = build_parser().parse_args(argv)
-    flash = args.flash or [parse_flash(f"{args.duration / 3:.0f}:{args.duration / 4.5:.0f}:6")]
-    spec = TrafficSpec(
-        duration_s=args.duration,
-        base_rps=args.base_rps,
-        mixes=(
-            TrafficMix("resnet50", "predict", 0.7),
-            TrafficMix("llm-7b", "generate", 0.3),
-        ),
-        diurnal_amplitude=max(0.0, args.diurnal),
-        diurnal_period_s=args.diurnal_period or 2.0 * args.duration,
-        flash_crowds=tuple(flash),
-        seed=args.seed,
-    )
-    harness = ReplayHarness(
-        args.members, spec,
-        sample_rate=args.sample_rate,
-        spans_per_s_budget=args.spans_per_s,
-        scrape_interval_s=args.scrape_interval,
-    )
+    if args.tenants:
+        from dmlc_tpu.loadgen import tenant_isolation_harness
+
+        harness = tenant_isolation_harness(
+            args.members, args.seed,
+            sample_rate=args.sample_rate,
+            spans_per_s_budget=args.spans_per_s,
+        )
+    else:
+        flash = args.flash or [parse_flash(f"{args.duration / 3:.0f}:{args.duration / 4.5:.0f}:6")]
+        spec = TrafficSpec(
+            duration_s=args.duration,
+            base_rps=args.base_rps,
+            mixes=(
+                TrafficMix("resnet50", "predict", 0.7),
+                TrafficMix("llm-7b", "generate", 0.3),
+            ),
+            diurnal_amplitude=max(0.0, args.diurnal),
+            diurnal_period_s=args.diurnal_period or 2.0 * args.duration,
+            flash_crowds=tuple(flash),
+            seed=args.seed,
+        )
+        harness = ReplayHarness(
+            args.members, spec,
+            sample_rate=args.sample_rate,
+            spans_per_s_budget=args.spans_per_s,
+            scrape_interval_s=args.scrape_interval,
+        )
     doc = harness.run()
     out = Path(args.out)
     out.write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n")
@@ -127,8 +176,12 @@ def main(argv=None) -> int:
             f"{obs.get('sqrt_bound_rpcs_per_cycle')}"
         )
 
+    if args.tenants:
+        failures.extend(f"tenants: {f}" for f in tenant_failures(doc))
+
     total = sum(m["requests"] for m in doc["models"].values())
-    print(f"slo_cert: {total} requests over {args.duration:.0f}s virtual, "
+    duration = float((doc.get("spec") or {}).get("duration_s", args.duration))
+    print(f"slo_cert: {total} requests over {duration:.0f}s virtual, "
           f"{obs.get('scrape_cycles')} scrape cycles at "
           f"{obs.get('leader_rpcs_per_cycle_avg', 0):.1f} leader RPCs/cycle "
           f"(bound {obs.get('sqrt_bound_rpcs_per_cycle', 0):.1f}); "
@@ -142,6 +195,22 @@ def main(argv=None) -> int:
               f"ok={body['ok']} shed={body['shed']} deadline={body['deadline']} "
               f"evicted={body['evicted']} p99={p99 if p99 is None else round(p99, 3)}"
               f" obj={obj} burn={body['fast_burn']:.2f}{alert}")
+    if args.tenants:
+        tsec = doc.get("tenants") or {}
+        for name, body in sorted((tsec.get("tenants") or {}).items()):
+            print(f"  tenant {name:<8} {body['priority']:<5} "
+                  f"share={body['share']} n={body['requests']:<6} "
+                  f"ok={body['ok']} shed={body['shed']} "
+                  f"over_quota={body['shed_over_quota']} "
+                  f"evicted={body['evicted']} "
+                  f"certified={body['certified']}")
+        auto = doc.get("autoscaler") or {}
+        print(f"  autoscaler: scale-up in {auto.get('scale_up_cycles')} "
+              f"fast-burn cycle(s), scaled_down={auto.get('scaled_down')}, "
+              f"breach_after_scale_down={auto.get('breach_after_scale_down')}, "
+              f"{len(auto.get('decisions') or ())} decisions "
+              f"({auto.get('flight_recorded')} flight-recorded); "
+              f"cross_tenant_evictions={tsec.get('cross_tenant_evictions')}")
     if failures:
         for f in failures:
             print(f"slo_cert FAIL: {f}", file=sys.stderr)
