@@ -104,9 +104,18 @@ Commands (reference: README.md:10-23):
   train | t                             broadcast model weights to members
   predict                               start/resume the inference jobs
   generate <model> <tok> [<tok> ...]    stream an LM generation (token ids;
-                                        flags: --max-new N --temp T); served
-                                        by the continuous-batching worker
+                                        flags: --max-new N --temp T --seed S);
+                                        routed through the leader's session
+                                        router when available — the stream
+                                        survives member death and drain
                                         (docs/GENERATE.md)
+  sessions                              leader's generation-session ledger:
+                                        id, model, member, tenant, tokens
+                                        delivered, state, migrations
+  drain <member> [--deadline S]         stop admitting generation sessions to
+                                        a member; residents finish within the
+                                        deadline or migrate (docs/OPERATIONS.md)
+  undrain <member>                      reopen a drained member for admission
   export <model>                        publish the model's StableHLO executable
   export-bundle <model> <dir>           write the native PJRT host bundle
                                         (program.mlir + weights + manifests;
@@ -251,23 +260,68 @@ class Cli:
             reply = n.predict()
             return f"started jobs: {', '.join(reply['jobs'])}"
         if cmd == "generate":
-            max_new, temp, rest = 32, 0.0, []
+            max_new, temp, seed, rest = 32, 0.0, None, []
             it = iter(args)
             for a in it:
                 if a == "--max-new":
                     max_new = int(next(it, "32"))
                 elif a == "--temp":
                     temp = float(next(it, "0"))
+                elif a == "--seed":
+                    seed = int(next(it, "0"))
                 else:
                     rest.append(a)
             if len(rest) < 2:
-                return "usage: generate <model> <tok> [<tok> ...] [--max-new N] [--temp T]"
+                return ("usage: generate <model> <tok> [<tok> ...] "
+                        "[--max-new N] [--temp T] [--seed S]")
             model, prompt = rest[0], [int(t) for t in rest[1:]]
-            reply = n.generate(model, prompt, max_new_tokens=max_new, temperature=temp)
+            reply = n.generate(
+                model, prompt, max_new_tokens=max_new, temperature=temp,
+                seed=seed,
+            )
             toks = reply["tokens"]
+            via = "router" if reply.get("routed") else "direct"
             return (
-                f"{model} @ {reply['member']}: {len(toks)} token(s)\n"
+                f"{model} @ {reply['member']} ({via}): {len(toks)} token(s)\n"
                 "  " + " ".join(str(t) for t in toks)
+            )
+        if cmd == "sessions":
+            try:
+                rows = [
+                    [s["id"], s["model"], s["member"], s["tenant"],
+                     s["delivered"], s["state"], s["migrations"]]
+                    for s in n.gen_sessions()
+                ]
+            except RpcError as e:
+                return f"session ledger unavailable: {e}"
+            if not rows:
+                return "no generation sessions"
+            return format_table(
+                ["session", "model", "member", "tenant", "delivered",
+                 "state", "migrations"],
+                rows,
+            )
+        if cmd == "drain":
+            opts = list(args)
+            try:
+                deadline = pop_option(opts, "--deadline", float)
+            except ValueError as e:
+                return str(e)
+            if len(opts) != 1:
+                return "usage: drain <member_addr> [--deadline S]"
+            r = n.drain(opts[0], deadline_s=deadline)
+            return (
+                f"draining {r['member']}: {r['resident']} resident "
+                f"session(s), deadline {r['deadline_s']:.1f}s "
+                "(residents finish or migrate; admission stopped)"
+            )
+        if cmd == "undrain":
+            if len(args) != 1:
+                return "usage: undrain <member_addr>"
+            r = n.undrain(args[0])
+            return (
+                f"{r['member']}: admission reopened"
+                if r.get("was") else f"{r['member']}: was not draining"
             )
         if cmd == "export":
             if len(args) != 1:
@@ -404,7 +458,21 @@ class Cli:
                         if ewma is not None
                         else f"    {m}: DEMOTED ({h['reason']})"
                     )
-            elif s.get("cluster_error"):
+            gen = s.get("cluster_generate")
+            if gen:
+                out.append(
+                    f"  generation sessions: {gen.get('sessions', 0)} live"
+                    f" / {gen.get('total', 0)} ledgered"
+                )
+                for m, d in sorted((gen.get("drains") or {}).items()):
+                    out.append(
+                        f"    drain {m}: "
+                        + ("COMPLETE" if d.get("complete") else "draining")
+                        + f" (deadline {d.get('deadline_s', 0):.1f}s,"
+                        f" age {d.get('age_s', 0):.1f}s,"
+                        f" reason {d.get('reason', '?')})"
+                    )
+            if s.get("cluster_error"):
                 out.append(f"  leader unreachable: {s['cluster_error']}")
             return "\n".join(out)
         if cmd == "metrics":

@@ -124,6 +124,7 @@ class StandbyLeader:
         scheduler,
         sdfs_leader=None,
         mesh_bootstrap=None,
+        genrouter=None,
         on_promote: Callable[[], None] | None = None,
     ):
         self.rpc = rpc
@@ -132,6 +133,7 @@ class StandbyLeader:
         self.scheduler = scheduler
         self.sdfs_leader = sdfs_leader
         self.mesh_bootstrap = mesh_bootstrap
+        self.genrouter = genrouter
         self.on_promote = on_promote
         self.is_leader = False
         # Highest leadership epoch observed anywhere (my own while leading):
@@ -204,6 +206,8 @@ class StandbyLeader:
             self.sdfs_leader.is_leading = False
         if self.mesh_bootstrap is not None:
             self.mesh_bootstrap.is_leading = False
+        if self.genrouter is not None:
+            self.genrouter.is_leading = False
         # Drop in-flight work and mirror the winner — identical to a fresh
         # standby joining.
         self._sync_from(winner)
@@ -219,6 +223,12 @@ class StandbyLeader:
             if self.mesh_bootstrap is not None:
                 wire = self.rpc.call(addr, "mesh.state", {}, timeout=2.0)
                 self.mesh_bootstrap.adopt_state(wire)
+            if self.genrouter is not None:
+                # Mirror the generation-session ledger so a promotion can
+                # re-adopt every live stream (scheduler/genrouter.py).
+                wire = self.rpc.call(addr, "gen.state", {}, timeout=2.0)
+                self._observe_epoch(wire.get("epoch"))
+                self.genrouter.adopt_state(wire)
         except (RpcUnreachable, RpcError) as e:
             log.warning("standby sync from %s failed: %s", addr, e)
 
@@ -246,9 +256,16 @@ class StandbyLeader:
             self.sdfs_leader.reconcile_from_members()
         if self.mesh_bootstrap is not None:
             self.mesh_bootstrap.is_leading = True
+        if self.genrouter is not None:
+            self.genrouter.is_leading = True
+            self.genrouter.epoch = list(self.seen_epoch)
         log.warning("%s: promoting to leader (epoch %s)", self.self_addr, self.seen_epoch)
         if self.scheduler.has_history():
             # Resume interrupted jobs from the replicated cursor.
             self.scheduler._start({})
+        if self.genrouter is not None:
+            # Re-adopt every live generation stream from the mirrored
+            # ledger — placements are kept, never re-placed.
+            self.genrouter.readopt()
         if self.on_promote is not None:
             self.on_promote()

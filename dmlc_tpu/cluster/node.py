@@ -46,6 +46,7 @@ from dmlc_tpu.cluster.sdfs import MemberStore, SdfsClient, SdfsLeader, SdfsMembe
 from dmlc_tpu.cluster.tenant import parse_tenants
 from dmlc_tpu.cluster.transport import UdpTransport
 from dmlc_tpu.scheduler.autoscaler import Autoscaler, ScaleTarget
+from dmlc_tpu.scheduler.genrouter import GenRouter
 from dmlc_tpu.scheduler.jobs import JobScheduler
 from dmlc_tpu.scheduler.placement import PlacementAdvisor, SloEvaluator, SloObjective
 from dmlc_tpu.scheduler.worker import (
@@ -360,7 +361,8 @@ class ClusterNode:
                     name, resident_bytes=lambda b=gb: _gen_resident(b)
                 )
             self.generate_worker = GenerateWorker(
-                self._gen_backends, session_ttl_s=config.gen_session_ttl_s
+                self._gen_backends, session_ttl_s=config.gen_session_ttl_s,
+                flight=self.flight,
             )
         self.model_loader = ModelLoader(
             self.store, self.worker.backends, extra=self._gen_backends
@@ -418,6 +420,7 @@ class ClusterNode:
         self.slo = None
         self.scrapetree = None
         self.autoscaler = None
+        self.genrouter = None
         if self.is_candidate:
             self._start_leader_services()
 
@@ -535,6 +538,11 @@ class ClusterNode:
                     hi=gb.max_slots,
                     models={name},
                     memory_bound=True,  # slots pin KV pages in HBM
+                    # Scale-down-through-drain: hold the shrink while more
+                    # slots than the proposed limit are mid-decode —
+                    # resident streams finish (or the router migrates
+                    # them), they are never cut.
+                    drain=lambda keep, b=gb: b.slots_resident() <= keep,
                 ))
             if self.advisor is not None:
                 for name in self.config.job_models:
@@ -545,6 +553,15 @@ class ClusterNode:
                         lo=config.autoscaler_min_replicas,
                         hi=config.autoscaler_max_replicas,
                         models={name},
+                        # Retiring a replica of a generation-serving model
+                        # goes through the router's drain (sessions finish
+                        # or migrate) before the shrink lands.
+                        drain=(
+                            (lambda keep, n=name:
+                             self.genrouter.release_capacity(n, keep))
+                            if self.genrouter is not None
+                            and name in self._gen_backends else None
+                        ),
                     ))
 
     def _replica_current(self, name: str) -> int:
@@ -570,6 +587,16 @@ class ClusterNode:
         if self.scheduler is not None:
             self.scheduler.request_replan(f"autoscale:{name}")
         return value
+
+    def _member_gauges(self, addr: str) -> dict:
+        """GenRouter's routing signal: one member's gauges from the last
+        obs scrape (LOCAL cache read by contract — never an RPC). Empty
+        while the member is dark; the router falls back to its own
+        session-residency view."""
+        reply = self.fleet_metrics.get(addr)
+        if not reply:
+            return {}
+        return (reply.get("metrics") or {}).get("gauges", {}) or {}
 
     def _fleet_hbm_used(self) -> float | None:
         """Worst-device HBM occupancy fraction across the last fleet scrape
@@ -695,6 +722,30 @@ class ClusterNode:
                 tenants=sorted(self.tenant_specs),
                 tenant_guard=self.tenant_guard,
             )
+        # Survivable generation sessions (scheduler/genrouter.py, ISSUE 19):
+        # the leader routes job.generate by the scraped per-member gauges
+        # and owns the session ledger that failure-triggered migration and
+        # drain work from. Built on every candidate — the routing verbs
+        # refuse until StandbyLeader promotes, and the standby sync loop
+        # mirrors the acting leader's ledger in the meantime.
+        self.genrouter = GenRouter(
+            self.rpc,
+            self.active_member_addrs,
+            metrics_for=self._member_gauges,
+            tenants=self.tenant_specs,
+            max_sessions=self.config.gen_router_max_sessions,
+            drain_deadline_s=self.config.gen_drain_deadline_s,
+            # Same idle budget as the member-side sweep: both planes reap
+            # an abandoned stream after the same silence.
+            session_ttl_s=self.config.gen_session_ttl_s,
+            timeout_s=self.config.rpc_deadline_s,
+            retry_policy=self.retry_policy,
+            metrics=self.metrics,
+            flight=self.flight,
+            clock=self.clock.monotonic,
+        )
+        self.scheduler.extra_status = self.genrouter.status
+        self.registry.gauge("gen_drain_active", self.genrouter.drain_active)
         # Delegated scrape tree (cluster/scrapetree.py): past
         # scrape_tree_min_members the scrape loop partitions the ring and
         # folds delegate partials instead of calling every member itself.
@@ -710,6 +761,7 @@ class ClusterNode:
         methods = {
             **self.sdfs_leader.methods(),
             **self.scheduler.methods(),
+            **self.genrouter.methods(),
             # Fleet-wide observability read-outs: the latest obs.metrics
             # snapshot per member (scraped by _obs_scrape_loop while
             # leading), raw and as Prometheus text, plus the tree-merged
@@ -758,6 +810,7 @@ class ClusterNode:
             self.scheduler,
             sdfs_leader=self.sdfs_leader,
             mesh_bootstrap=self.mesh_bootstrap,
+            genrouter=self.genrouter,
         )
 
     # ---- topology ------------------------------------------------------
@@ -902,6 +955,7 @@ class ClusterNode:
             for _ in range(max(1, self.config.dispatch_workers)):
                 self._spawn(self._dispatch_loop)
             self._spawn(self._standby_loop)
+            self._spawn(self._genrouter_loop)
 
     def _spawn(self, fn) -> None:
         def run() -> None:
@@ -1178,6 +1232,15 @@ class ClusterNode:
         if self.standby is not None and self.standby.is_leader:
             fn()
 
+    def _genrouter_loop(self) -> None:
+        """While leading: migrate generation sessions off dead, convicted,
+        or drain-expired members and retire completed drains
+        (scheduler/genrouter.py tick)."""
+        self._timer(
+            "genrouter", self.config.leader_probe_interval_s,
+            lambda: self._if_leading(self.genrouter.tick),
+        )
+
     # ---- CLI-facing verbs ---------------------------------------------
 
     def join(self, introducer_gossip_addr: str) -> None:
@@ -1287,13 +1350,31 @@ class ClusterNode:
         prompt: list[int],
         max_new_tokens: int = 32,
         temperature: float = 0.0,
+        seed: int | None = None,
     ) -> dict:
-        """CLI verb: stream one generation to completion. Served locally
-        when this node hosts the model's generation backend, else from the
-        first active member that does (docs/GENERATE.md)."""
-        from dmlc_tpu.cluster.rpc import RpcError
+        """CLI verb: stream one generation to completion. Routed through
+        the acting leader's session router when one answers — the stream
+        then survives member death, drain, and leader failover
+        (docs/GENERATE.md §Routing) — with member-direct dialing as the
+        fallback for routerless fleets."""
+        from dmlc_tpu.cluster.rpc import RpcError, RpcUnreachable
         from dmlc_tpu.generate import worker as gen_worker
 
+        try:
+            tokens = gen_worker.generate(
+                self.rpc, self.tracker.current, model, prompt,
+                max_new_tokens=max_new_tokens, temperature=temperature,
+                seed=seed, poll_timeout=self.config.rpc_deadline_s,
+            )
+            return {"member": self.tracker.current, "routed": True,
+                    "tokens": tokens}
+        except (RpcUnreachable, RpcError) as e:
+            msg = str(e)
+            if not isinstance(e, RpcUnreachable) and \
+                    "unknown method" not in msg and \
+                    "not the active leader" not in msg:
+                raise  # a routed verdict (quota shed, no member, …)
+            log.warning("leader routing unavailable (%s); dialing members", e)
         addrs = [self.self_member_addr] if model in self._gen_backends else []
         addrs += [a for a in self.active_member_addrs() if a not in addrs]
         last: Exception | None = None
@@ -1302,9 +1383,9 @@ class ClusterNode:
                 tokens = gen_worker.generate(
                     self.rpc, addr, model, prompt,
                     max_new_tokens=max_new_tokens, temperature=temperature,
-                    poll_timeout=self.config.rpc_deadline_s,
+                    seed=seed, poll_timeout=self.config.rpc_deadline_s,
                 )
-                return {"member": addr, "tokens": tokens}
+                return {"member": addr, "routed": False, "tokens": tokens}
             except RpcError as e:
                 last = e
                 if "not served here" in str(e):
@@ -1324,6 +1405,32 @@ class ClusterNode:
             self.tracker.current, "job.assignments", {},
             timeout=self.config.rpc_deadline_s,
         )["assigned"]
+
+    def gen_sessions(self) -> list[dict]:
+        """CLI ``sessions`` verb: the acting leader's generation-session
+        ledger table (scheduler/genrouter.py)."""
+        return self.rpc.call(
+            self.tracker.current, "job.generate_sessions", {},
+            timeout=self.config.rpc_deadline_s,
+        )["sessions"]
+
+    def drain(self, member: str, deadline_s: float | None = None) -> dict:
+        """CLI ``drain <member>``: stop admitting generation sessions to a
+        member; residents finish within the deadline or migrate."""
+        payload: dict = {"member": member}
+        if deadline_s is not None:
+            payload["deadline_s"] = float(deadline_s)
+        return self.rpc.call(
+            self.tracker.current, "job.drain", payload,
+            timeout=self.config.rpc_deadline_s,
+        )
+
+    def undrain(self, member: str) -> dict:
+        """CLI ``undrain <member>``: reopen a drained member for admission."""
+        return self.rpc.call(
+            self.tracker.current, "job.undrain", {"member": member},
+            timeout=self.config.rpc_deadline_s,
+        )
 
     def status(self, remote: bool = True) -> dict:
         """The overload-control picture from where this node stands
@@ -1365,6 +1472,10 @@ class ClusterNode:
                 )
                 out["cluster"] = reply.get("overload", {})
                 out["cluster_leading"] = bool(reply.get("leading"))
+                if reply.get("generate"):
+                    # Router-side session/drain picture (GenRouter.status):
+                    # the CLI renders drain state per member from this.
+                    out["cluster_generate"] = reply["generate"]
             except Exception as e:
                 out["cluster_error"] = str(e)
         return out

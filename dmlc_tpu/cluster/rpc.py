@@ -79,6 +79,11 @@ IDEMPOTENT_VERBS: dict[str, str] = {
     # CUMULATIVE ack covers them, so a replayed poll re-reads identical
     # chunks and the client dedups by seq (generate/slots.GenStream)
     "job.generate_poll": "cumulative-ack chunk retention dedups replays",
+    # session-plane verbs keyed by a caller-chosen gen_id
+    "job.generate": "gen_id dedup: a re-submit finds the live stream "
+                    "(resumed) instead of a second prefill",
+    "job.generate_cancel": "keyed delete; a repeat finds nothing and "
+                           "reports cancelled=False",
 }
 
 #: dmlc-mc schedule-choice actions a SimRpcNetwork hook may return.

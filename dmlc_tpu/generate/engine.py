@@ -21,6 +21,16 @@ lint J2's regression class):
   page pools are DONATED through both programs, so exactly one generation
   of the cache exists in device memory.
 
+Sampling is **per-slot position-seeded**: the categorical draw for the
+token at sequence position ``p`` of a request seeded ``s`` uses the key
+``fold_in(fold_in(PRNGKey(0), s), p)`` — a pure function of (seed,
+position), independent of batch composition, step count, or which slot row
+the request occupies. That is what makes a migrated stream token-identical
+to its unkilled reference (docs/GENERATE.md §Migration): re-prefilling
+``prompt + delivered_prefix`` on another member with the same seed resumes
+the identical random sequence at the identical position, so the
+continuation equals the uninterrupted run token for token.
+
 The forward math mirrors ``parallel.sp_transformer.SPTransformerLM``
 parameter-for-parameter (same trees, flax LayerNorm/Dense/gelu semantics,
 dense_attention's f32 score discipline), so decode logits match the full-
@@ -163,7 +173,13 @@ class GenerationEngine:
         self.tokens_out = 0
         self.last_tokens = np.zeros(self.max_slots, np.int32)
         self.last_logits: np.ndarray | None = None
-        self._key = jax.random.PRNGKey(int(seed))
+        # Per-slot sampling seeds (position-seeded RNG, module docstring).
+        # Default seeds derive deterministically from the engine seed and a
+        # join counter; a caller-supplied seed (the router's migration path)
+        # overrides so a resumed stream replays the same random sequence.
+        self.seeds = np.zeros(self.max_slots, np.uint32)
+        self._base_seed = int(seed)
+        self._joins = 0
 
         # The two compiled programs — built exactly once (J2/H1 contract),
         # census-wrapped so a steady-state recompile of either is a labeled
@@ -207,7 +223,7 @@ class GenerationEngine:
         return_logits = self.return_logits
 
         def step(variables: Any, k_state: Any, v_state: Any, tokens: Any,
-                 lengths: Any, active: Any, page_table: Any, key: Any,
+                 lengths: Any, active: Any, page_table: Any, seeds: Any,
                  temps: Any) -> Any:
             p = self._params(variables)
             pos = jnp.minimum(lengths, self.max_len - 1)
@@ -242,7 +258,9 @@ class GenerationEngine:
                 x = x + _dense(h2, blk["mlp_out"])
             x = _layer_norm(x, p["ln_f"])
             logits = _dense(x, p["head"]).astype(jnp.float32)  # [B, V]
-            nxt = _sample(logits, key, temps)
+            # The token sampled here lands at sequence position ``lengths``
+            # (pre-increment) — the position the key must be folded on.
+            nxt = _sample(logits, seeds, lengths, temps)
             if return_logits:
                 return k_state, v_state, nxt, logits
             return k_state, v_state, nxt
@@ -261,7 +279,7 @@ class GenerationEngine:
         s_pad = self.max_prefill
 
         def prefill(variables: Any, tokens: Any, length: Any, k_state: Any,
-                    v_state: Any, dest: Any, key: Any, temp: Any) -> Any:
+                    v_state: Any, dest: Any, seed: Any, temp: Any) -> Any:
             """tokens: [1, s_pad]; length: [] int32 (real prompt length);
             dest: page row [max_pages_per_slot] (paged) or slot index []
             (contiguous)."""
@@ -299,7 +317,14 @@ class GenerationEngine:
             x = _layer_norm(x, p["ln_f"])
             logits = _dense(x, p["head"]).astype(jnp.float32)  # [1, S, V]
             last = jnp.take(logits[0], length - 1, axis=0)     # [V]
-            nxt = _sample(last[None], key, temp[None])[0]
+            # First sampled token comes from position ``length - 1`` — the
+            # same position a resumed prefill of prompt+prefix re-samples.
+            nxt = _sample(
+                last[None],
+                jnp.reshape(seed, (1,)),
+                jnp.reshape(length - 1, (1,)),
+                temp[None],
+            )[0]
             return k_state, v_state, nxt, last
 
         return jax.jit(prefill, donate_argnums=(3, 4))
@@ -325,10 +350,12 @@ class GenerationEngine:
         return [s for s in range(self.max_slots) if not self.active[s]]
 
     def join(self, slot: int, prompt: Any, *, temperature: float = 0.0,
-             pages: list[int] | None = None) -> int:
+             pages: list[int] | None = None, seed: int | None = None) -> int:
         """Prefill ``prompt`` into ``slot`` and return the first sampled
-        token. ``pages`` is the submit-time reservation (paged mode)."""
-        import jax
+        token. ``pages`` is the submit-time reservation (paged mode).
+        ``seed`` keys the position-seeded sampling RNG; passing the same
+        seed with ``prompt + delivered_prefix`` resumes a migrated stream
+        token-identically (module docstring)."""
         import jax.numpy as jnp
 
         prompt = np.asarray(prompt, np.int32)
@@ -350,7 +377,10 @@ class GenerationEngine:
             dest = jnp.int32(slot)
         padded = np.zeros(self.max_prefill, np.int32)
         padded[: prompt.size] = prompt
-        self._key, sub = jax.random.split(self._key)
+        if seed is None:
+            seed = (self._base_seed * 1_000_003 + self._joins) % (1 << 31)
+        self._joins += 1
+        seed = int(seed) & 0xFFFFFFFF
         k_state, v_state, nxt, last = self._prefill(
             self._variables,
             jnp.asarray(padded[None]),
@@ -358,7 +388,7 @@ class GenerationEngine:
             self._k_state,
             self._v_state,
             dest,
-            sub,
+            jnp.uint32(seed),
             jnp.float32(temperature),
         )
         self._set_state(k_state, v_state)
@@ -366,6 +396,7 @@ class GenerationEngine:
         self.lengths[slot] = prompt.size
         self.active[slot] = True
         self.temps[slot] = float(temperature)
+        self.seeds[slot] = seed
         self.last_tokens[slot] = first
         self.tokens_out += 1
         return first
@@ -386,11 +417,9 @@ class GenerationEngine:
         active rows meaningful). Host state advances for active slots."""
         import time
 
-        import jax
         import jax.numpy as jnp
 
         t0 = time.perf_counter()
-        self._key, sub = jax.random.split(self._key)
         table = (
             jnp.asarray(self.cache.page_table)
             if self.cache_mode == "paged"
@@ -404,7 +433,7 @@ class GenerationEngine:
             jnp.asarray(self.lengths),
             jnp.asarray(self.active),
             table,
-            sub,
+            jnp.asarray(self.seeds),
             jnp.asarray(self.temps),
         )
         if self.return_logits:
@@ -431,6 +460,7 @@ class GenerationEngine:
         self.active[slot] = False
         self.lengths[slot] = 0
         self.temps[slot] = 0.0
+        self.seeds[slot] = 0
         self.last_tokens[slot] = 0
         if self.cache_mode == "paged":
             return self.cache.release(slot)
@@ -496,13 +526,23 @@ class GenerationEngine:
         return out
 
 
-def _sample(logits: Any, key: Any, temps: Any) -> Any:
-    """Greedy at temperature <= 0, categorical at T otherwise — per row.
-    logits: [B, V] f32; temps: [B] f32."""
+def _sample(logits: Any, seeds: Any, positions: Any, temps: Any) -> Any:
+    """Greedy at temperature <= 0, position-seeded categorical otherwise —
+    per row. logits: [B, V] f32; seeds: [B] u32; positions: [B] i32 (the
+    sequence position each row's token lands at); temps: [B] f32. The key
+    ``fold_in(fold_in(PRNGKey(0), seed), position)`` depends only on the
+    (seed, position) pair, never on batch composition — the property the
+    migration token-identity guarantee rests on (module docstring)."""
     import jax
     import jax.numpy as jnp
 
     greedy = jnp.argmax(logits, axis=-1)
     temp_safe = jnp.maximum(temps, 1e-6)[:, None]
-    sampled = jax.random.categorical(key, logits / temp_safe, axis=-1)
+    base = jax.random.PRNGKey(0)
+    keys = jax.vmap(
+        lambda s, p: jax.random.fold_in(jax.random.fold_in(base, s), p)
+    )(seeds, positions)
+    sampled = jax.vmap(
+        lambda k, row: jax.random.categorical(k, row, axis=-1)
+    )(keys, logits / temp_safe)
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)

@@ -66,7 +66,14 @@ class GenStream:
     ``chunks_after(ack)`` returns every chunk with seq > ack — chunks are
     retained until covered by a later cumulative ack, so a lost/retried
     poll re-reads the same chunks and the consumer dedups by seq.
-    ``tokens()``/``wait`` serve in-process consumers (CLI, tests)."""
+    ``tokens()``/``wait`` serve in-process consumers (CLI, tests).
+
+    Lifecycle hooks for the session plane (generate/worker.py,
+    scheduler/genrouter.py): ``cancel`` requests a cooperative exit — the
+    decode loop retires the slot between steps with a ``cancelled:`` error;
+    ``hold``/``unhold`` pin the stream against the worker's TTL sweep while
+    a migration handoff is reading it; ``step_gen`` is the engine step
+    count at the last delivered token, the sweep's liveness witness."""
 
     def __init__(self, request_id: str) -> None:
         self.request_id = request_id
@@ -77,6 +84,9 @@ class GenStream:
         self.done = False
         self.error: str | None = None
         self.acked = 0
+        self.cancelled = False
+        self.step_gen = 0
+        self._holds = 0
 
     # ---- producer --------------------------------------------------------
 
@@ -98,6 +108,28 @@ class GenStream:
             self.done = True
             self.error = error
             self._cv.notify_all()
+
+    # ---- session-plane hooks --------------------------------------------
+
+    def cancel(self) -> None:
+        """Request a cooperative exit: the decode loop retires the slot
+        between steps (never mid-step). Idempotent; a finished stream is
+        left as-is."""
+        with self._cv:
+            self.cancelled = True
+            self._cv.notify_all()
+
+    def hold(self) -> None:
+        with self._cv:
+            self._holds += 1
+
+    def unhold(self) -> None:
+        with self._cv:
+            self._holds = max(0, self._holds - 1)
+
+    def held(self) -> bool:
+        with self._cv:
+            return self._holds > 0
 
     # ---- consumer --------------------------------------------------------
 
@@ -146,13 +178,14 @@ class _Slot:
     __slots__ = (
         "stream", "prompt", "max_new_tokens", "temperature", "eos_id",
         "deadline", "trace_ctx", "pages", "emitted", "slot", "submitted_t",
-        "tenant",
+        "tenant", "seed",
     )
 
     def __init__(self, stream: GenStream, prompt: list[int],
                  max_new_tokens: int, temperature: float, eos_id: int | None,
                  deadline: Any, trace_ctx: Any, pages: list[int],
-                 submitted_t: float, tenant: str) -> None:
+                 submitted_t: float, tenant: str,
+                 seed: int | None = None) -> None:
         self.stream = stream
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -165,6 +198,7 @@ class _Slot:
         self.slot = -1
         self.submitted_t = submitted_t
         self.tenant = tenant
+        self.seed = seed
 
 
 class SlotScheduler:
@@ -260,15 +294,26 @@ class SlotScheduler:
         eos_id: int | None = None,
         request_id: str | None = None,
         deadline: Any = None,
+        seed: int | None = None,
+        resume_tokens: Iterable[int] | None = None,
     ) -> GenStream:
         """Admit one generation request; returns its stream immediately.
         Sheds with a typed ``Overloaded`` when the slot table (plus the
         bounded wait queue) or the page pool cannot take it. Captures the
         ambient RPC deadline and trace context (the decode loop carries
-        both forward)."""
+        both forward).
+
+        ``seed`` keys the engine's position-seeded sampling RNG.
+        ``resume_tokens`` is the migration entry (docs/GENERATE.md
+        §Migration): tokens already delivered to the client elsewhere are
+        prefilled along with the prompt (same seed → the continuation is
+        token-identical to the uninterrupted run), and the stream emits
+        only the ``max_new_tokens`` NEW tokens from the resume point on."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("prompt must be non-empty")
+        if resume_tokens is not None:
+            prompt = prompt + [int(t) for t in resume_tokens]
         if len(prompt) > self.engine.max_prefill:
             raise ValueError(
                 f"prompt of {len(prompt)} tokens exceeds max_prefill="
@@ -316,7 +361,7 @@ class SlotScheduler:
             slot = _Slot(
                 stream, prompt, int(max_new_tokens), float(temperature),
                 eos_id, deadline, tracectx.current(), pages, self.clock(),
-                tenant,
+                tenant, seed,
             )
             self._pending.append(slot)
             self.ledger.acquire(tenant)
@@ -423,6 +468,14 @@ class SlotScheduler:
                 self._ledger_release(req)
                 req.stream.finish("deadline: expired before a slot freed")
                 continue
+            if req.stream.cancelled:
+                # Cancelled while waiting (router migrated it away, or the
+                # client gave up): a prefill now would be dead work.
+                self._unpend(req)
+                self.engine.release_reservation(req.pages)
+                self._ledger_release(req)
+                req.stream.finish("cancelled: before a slot freed")
+                continue
             req.slot = free[0]
             try:
                 with tracectx.bind(req.trace_ctx):
@@ -431,6 +484,7 @@ class SlotScheduler:
                         first = self.engine.join(
                             req.slot, req.prompt,
                             temperature=req.temperature, pages=req.pages,
+                            seed=req.seed,
                         )
             except Exception as e:
                 # A bad request (or a prefill failure) fails ITS stream,
@@ -456,7 +510,7 @@ class SlotScheduler:
                 # (a request entered a batch already mid-decode).
                 self.flight.note(
                     "slot_admit", slot=req.slot, prompt=len(req.prompt),
-                    step=self.engine.steps,
+                    step=self.engine.steps, request=req.stream.request_id,
                     pages=len(self.engine.cache.slot_pages(req.slot))
                     if self.engine.cache_mode == "paged" else 0,
                 )
@@ -509,6 +563,11 @@ class SlotScheduler:
         for req in list(self._resident):
             if req not in self._resident:
                 continue  # already evicted as another slot's page victim
+            if req.stream.cancelled:
+                self._exit(req, "cancel",
+                           error="cancelled: stream cancelled",
+                           counted=False)
+                continue
             if req.deadline is not None and req.deadline.expired():
                 self._exit(req, "deadline",
                            error="deadline: generation exceeded its budget")
@@ -547,6 +606,7 @@ class SlotScheduler:
 
     def _deliver(self, req: _Slot, token: int) -> None:
         req.emitted += 1
+        req.stream.step_gen = self.engine.steps
         req.stream.push([token])
         self.tokens_streamed += 1
         if self.metrics is not None:

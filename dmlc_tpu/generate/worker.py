@@ -7,20 +7,32 @@ control-plane fabric is strict request/response (cluster/rpc.py), so
 streaming rides a chunk-poll protocol (wire format: docs/GENERATE.md):
 
 - ``job.generate``  {model, prompt:[int], max_new_tokens, temperature?,
-  eos_id?} -> {gen_id}. Admission happens HERE (slot table + page pool,
-  typed ``Overloaded`` on refusal) and the ambient deadline/trace context
-  captured by the slot scheduler ride the whole generation.
+  eos_id?, gen_id?, seed?, resume_tokens?} -> {gen_id}. Admission happens
+  HERE (slot table + page pool, typed ``Overloaded`` on refusal) and the
+  ambient deadline/trace context captured by the slot scheduler ride the
+  whole generation. A caller-supplied ``gen_id`` makes the verb IDEMPOTENT:
+  re-submitting a live id returns it without a second prefill — the
+  property the router's migration retry (leader failover mid-migration)
+  leans on for its ≤1-prefill-per-failure bound. ``seed`` keys the
+  position-seeded sampling RNG and ``resume_tokens`` re-prefills an
+  already-delivered prefix (scheduler/genrouter.py migration entry).
 - ``job.generate_poll``  {gen_id, ack:int} -> {chunks: [[seq, [tok,..]],
   ...], done, error?}. Chunks are seq-numbered and retained until covered
   by the CUMULATIVE ack, so a retried poll (lost reply, client crash +
   resume) re-reads identical chunks and the client dedups by seq —
   exactly-once token delivery over an at-least-once fabric.
-- ``job.generate_cancel`` {gen_id} -> {cancelled} releases the consumer's
-  interest; the session is dropped at the next sweep.
+- ``job.generate_cancel`` {gen_id, reason?} -> {cancelled} releases the
+  consumer's interest and cancels the stream cooperatively (the decode
+  loop retires the slot between steps, never mid-step).
 
 Sessions for which no poll arrives within ``session_ttl_s`` are swept (an
-abandoned client must not pin chunks forever); ``generate_stream`` /
-``generate`` are the client helpers the CLI and tests drive.
+abandoned client must not pin chunks forever) — but never while the
+backend is still stepping the stream or a migration handoff holds it: the
+sweep compares the stream's ``step_gen`` against its last observation and
+skips held streams, so an in-flight decode step or handoff cannot race a
+reap. Every sweep/cancel is flight-recorded (``session_sweep`` with reason
+``ttl``/``cancel``/``migrated``). ``generate_stream`` / ``generate`` are
+the client helpers the CLI and tests drive.
 """
 
 from __future__ import annotations
@@ -136,6 +148,14 @@ class GenerationBackend:
             return self.max_slots
         return int(sched.set_limits(max_active=max_active)["max_active"])
 
+    def slots_resident(self) -> int:
+        """Live decode slots right now — the autoscaler's drain seam:
+        shrinking the slot limit below this would abandon streams
+        mid-decode, so scale-down holds until residency fits."""
+        with self._lock:
+            sched = self._scheduler
+        return int(sched.engine.slots_active) if sched is not None else 0
+
     def submit(self, prompt: Iterable[int], **kw: Any) -> GenStream:
         return self._ensure().submit(prompt, **kw)
 
@@ -156,11 +176,14 @@ class GenerationBackend:
 
 
 class _Session:
-    __slots__ = ("stream", "last_poll")
+    __slots__ = ("stream", "last_poll", "step_gen")
 
     def __init__(self, stream: GenStream, now: float) -> None:
         self.stream = stream
         self.last_poll = now
+        # Stream step generation at the last sweep observation: a stream
+        # whose backend stepped since then is live regardless of polls.
+        self.step_gen = 0
 
 
 class GenerateWorker:
@@ -168,10 +191,12 @@ class GenerateWorker:
 
     def __init__(self, backends: dict[str, GenerationBackend], *,
                  session_ttl_s: float = 120.0,
-                 clock: Callable[[], float] = time.monotonic) -> None:
+                 clock: Callable[[], float] = time.monotonic,
+                 flight: Any = None) -> None:
         self.backends = dict(backends)
         self.session_ttl_s = float(session_ttl_s)
         self.clock = clock
+        self.flight = flight
         self._sessions: dict[str, _Session] = {}
         self._lock = threading.Lock()
 
@@ -192,7 +217,14 @@ class GenerateWorker:
 
     def _generate(self, p: dict[str, Any]) -> dict[str, Any]:
         backend = self._backend(p["model"])
-        gen_id = os.urandom(8).hex()
+        gen_id = str(p.get("gen_id") or os.urandom(8).hex())
+        with self._lock:
+            if gen_id in self._sessions:
+                # Idempotent re-submit (router retry across a leader
+                # failover): the live session IS the answer; a second
+                # prefill would fork the stream and double-bill the slots.
+                return {"gen_id": gen_id, "model": p["model"],
+                        "resumed": True}
         try:
             stream = backend.submit(
                 [int(t) for t in p["prompt"]],
@@ -200,13 +232,22 @@ class GenerateWorker:
                 temperature=float(p.get("temperature", 0.0)),
                 eos_id=int(p["eos_id"]) if p.get("eos_id") is not None else None,
                 request_id=gen_id,
+                seed=int(p["seed"]) if p.get("seed") is not None else None,
+                resume_tokens=p.get("resume_tokens"),
             )
         except ValueError as e:
             raise RpcError(str(e))
         now = self.clock()
         with self._lock:
             self._sweep_locked(now)
-            self._sessions[gen_id] = _Session(stream, now)
+            if gen_id in self._sessions:
+                dup = stream  # lost a concurrent duplicate-submit race
+            else:
+                self._sessions[gen_id] = _Session(stream, now)
+                dup = None
+        if dup is not None:
+            dup.cancel()
+            return {"gen_id": gen_id, "model": p["model"], "resumed": True}
         return {"gen_id": gen_id, "model": p["model"]}
 
     def _poll(self, p: dict[str, Any]) -> dict[str, Any]:
@@ -225,22 +266,41 @@ class GenerateWorker:
         return session.stream.chunks_after(int(p.get("ack", 0)))
 
     def _cancel(self, p: dict[str, Any]) -> dict[str, Any]:
+        reason = str(p.get("reason", "cancel"))
         with self._lock:
             session = self._sessions.pop(p["gen_id"], None)
-        # The slots remain driven to completion (mid-step cancellation is a
-        # follow-up; the slot's max_new_tokens bounds the wasted work) —
-        # cancel only releases the chunk retention.
+        if session is not None:
+            # Cooperative: the decode loop retires the slot between steps
+            # (never mid-step), freeing its pages for the next admit — a
+            # migrated-away session must not keep decoding dead tokens.
+            session.stream.cancel()
+            if self.flight is not None:
+                self.flight.note("session_sweep", gen_id=p["gen_id"],
+                                 reason=reason)
         return {"cancelled": session is not None}
 
     def _sweep_locked(self, now: float) -> None:
-        dead = [
-            gid for gid, s in self._sessions.items()
-            if now - s.last_poll > self.session_ttl_s
-        ]
-        for gid in dead:
+        for gid, s in list(self._sessions.items()):
+            if now - s.last_poll <= self.session_ttl_s:
+                continue
+            stream = s.stream
+            if stream.held():
+                continue  # migration handoff mid-read: never reap under it
+            gen = int(stream.step_gen)
+            if not stream.done and gen != s.step_gen:
+                # The backend stepped this stream since the last sweep
+                # observation: it is live even with no polls arriving
+                # (slow consumer, router mid-failover). Reap only once the
+                # decode goes quiet too — the step-generation guard that
+                # closes the sweep-vs-in-flight-step race.
+                s.step_gen = gen
+                continue
             self._sessions.pop(gid, None)
-        if dead:
-            log.info("swept %d abandoned generation session(s)", len(dead))
+            stream.cancel()
+            if self.flight is not None:
+                self.flight.note("session_sweep", gen_id=gid, reason="ttl",
+                                 idle_s=round(now - s.last_poll, 3))
+            log.info("swept abandoned generation session %s", gid)
 
     def summary(self) -> dict[str, Any]:
         with self._lock:
@@ -265,6 +325,7 @@ def generate_stream(
     max_new_tokens: int,
     temperature: float = 0.0,
     eos_id: int | None = None,
+    seed: int | None = None,
     poll_timeout: float = 10.0,
     poll_interval_s: float = 0.0,
     sleep: Callable[[float], None] = time.sleep,
@@ -272,17 +333,19 @@ def generate_stream(
     """Submit and yield tokens as they stream. Exactly-once: chunks are
     dedup'd by seq and acked cumulatively, so a retried poll after a lost
     reply cannot duplicate or drop tokens. Raises the remote's typed error
-    (Overloaded / DeadlineExceeded / RpcError) on failure."""
+    (Overloaded / DeadlineExceeded / RpcError) on failure. ``seed`` pins
+    the sampling RNG (temperature > 0) to a reproducible sequence."""
     from dmlc_tpu.cluster.rpc import remote_error
 
+    payload: dict[str, Any] = {
+        "model": model, "prompt": [int(t) for t in prompt],
+        "max_new_tokens": int(max_new_tokens),
+        "temperature": float(temperature), "eos_id": eos_id,
+    }
+    if seed is not None:
+        payload["seed"] = int(seed)
     with tracer.span("cli/generate", model=model):
-        reply = rpc.call(
-            addr, "job.generate",
-            {"model": model, "prompt": [int(t) for t in prompt],
-             "max_new_tokens": int(max_new_tokens),
-             "temperature": float(temperature), "eos_id": eos_id},
-            timeout=poll_timeout,
-        )
+        reply = rpc.call(addr, "job.generate", payload, timeout=poll_timeout)
         gen_id = reply["gen_id"]
         acked = 0
         while True:

@@ -996,6 +996,7 @@ def validate_slo_cert(doc: dict) -> list[str]:
             problems.append(f"models.{model}: outcome counts != requests")
     problems.extend(_validate_tenants(doc, models))
     problems.extend(_validate_autoscaler(doc))
+    problems.extend(validate_sessions(doc))
     return problems
 
 
@@ -1158,6 +1159,325 @@ def tenant_isolation_harness(
     return ReplayHarness(n_members, two_tenant_flash_spec(seed), **params)
 
 
+# ---------------------------------------------------------------------------
+# The canonical session-churn scenario
+# ---------------------------------------------------------------------------
+#
+# One definition, three consumers again: tests/test_genrouter.py pins its
+# verdicts across the chaos-seed matrix, tools/slo_cert.py --sessions
+# replays it standalone, and tools/ci_check.sh runs that per seed leg.
+# Sixteen generation streams across two tenants ride real GenerateWorkers
+# behind the real session router; the seeded schedule kills two members
+# mid-decode and drains a third, and the certificate's ``sessions``
+# section must show every stream completing token-identically to its
+# unkilled reference — zero lost, zero duplicated — with migrations
+# bounded by the sessions actually resident at each disruption and the
+# drain dropping nothing.
+
+
+def _session_plan(prompt: list[int], seed: int, n: int) -> list[int]:
+    """A toy decoder's full output: token i is a pure function of
+    (prompt, seed, i) — the same contract the engine's position-seeded
+    sampling provides, so resume-from-prefix continues identically."""
+    return [int(prompt[0]) * 1000 + int(seed) % 97 * 10 + i + 1
+            for i in range(n)]
+
+
+class _SessionDecoder:
+    """Deterministic GenerationBackend stand-in with the resume-from-prefix
+    entry: ``resume_tokens`` skips the already-delivered positions."""
+
+    def __init__(self, member: str, prefills: dict[str, int]):
+        self.member = member
+        self.prefills = prefills  # shared across members: sid -> count
+        self.live: list[tuple[Any, list[int]]] = []
+
+    def submit(self, prompt: list[int], *, max_new_tokens: int,
+               temperature: float = 0.0, eos_id: int | None = None,
+               request_id: str = "", seed: int | None = None,
+               resume_tokens: Any = None) -> Any:
+        from dmlc_tpu.generate.slots import GenStream
+
+        stream = GenStream(request_id)
+        done = [int(t) for t in resume_tokens] if resume_tokens else []
+        full = _session_plan(prompt, seed or 0, len(done) + int(max_new_tokens))
+        self.prefills[request_id] = self.prefills.get(request_id, 0) + 1
+        self.live.append((stream, full[len(done):]))
+        return stream
+
+    def step(self) -> None:
+        for stream, remaining in self.live:
+            if stream.done or stream.cancelled:
+                continue
+            if remaining:
+                stream.push([remaining.pop(0)])
+            if not remaining:
+                stream.finish()
+
+
+class SessionChurnHarness:
+    """Generate-heavy churn against the REAL session tier: ``n_members``
+    real ``GenerateWorker``s over deterministic toy decoders on a
+    ``SimRpcNetwork``, fronted by a real ``GenRouter`` holding the tenant
+    ledger (``ISOLATION_TENANTS``). The seeded schedule interleaves decode
+    steps, client polls, and leader ticks with ``kills`` member crashes
+    mid-decode and ``drains`` operator drains; ``run()`` drives everything
+    to completion and returns the sessions-section certificate document."""
+
+    def __init__(self, n_members: int, seed: int, *, streams: int = 16,
+                 kills: int = 2, drains: int = 1, max_rounds: int = 600):
+        if n_members < kills + drains + 1:
+            raise ValueError("need a survivor: n_members > kills + drains")
+        self.n_members = int(n_members)
+        self.seed = int(seed)
+        self.streams = int(streams)
+        self.kills = int(kills)
+        self.drains = int(drains)
+        self.max_rounds = int(max_rounds)
+
+    def run(self) -> dict[str, Any]:
+        from dmlc_tpu.generate.worker import GenerateWorker
+        from dmlc_tpu.scheduler.genrouter import GenRouter
+
+        rng = random.Random(self.seed)
+        net = SimRpcNetwork()
+        alive = {f"m{i}" for i in range(self.n_members)}
+        prefills: dict[str, int] = {}
+        decoders: dict[str, _SessionDecoder] = {}
+        for m in sorted(alive):
+            decoders[m] = _SessionDecoder(m, prefills)
+            worker = GenerateWorker(
+                {"toy": decoders[m]},  # type: ignore[dict-item]
+                session_ttl_s=1e9, clock=net.clock,
+            )
+            net.serve(m, worker.methods())
+        router = GenRouter(
+            net.client("L"),
+            lambda: sorted(alive),
+            tenants=tenant_mod.parse_tenants(ISOLATION_TENANTS),
+            max_sessions=4 * self.streams,
+            drain_deadline_s=0.0,
+            session_ttl_s=1e9,
+            timeout_s=5.0,
+            clock=net.clock,
+        )
+        router.is_leading = True
+        router.epoch = [1, "L"]
+        net.serve("L", router.methods())
+
+        # Seeded stream population across the two tenants. Each stream's
+        # reference is its plan — what an unkilled run would deliver.
+        clients: list[dict[str, Any]] = []
+        for i in range(self.streams):
+            tenant = "acme" if i % 2 else tenant_mod.DEFAULT_TENANT
+            prompt, sd = [i + 1], self.seed * 1000 + i
+            tokens = rng.randint(6, 12)
+            clients.append({
+                "cid": f"c{i}", "tenant": tenant, "prompt": prompt,
+                "seed": sd, "plan": _session_plan(prompt, sd, tokens),
+                "tokens": tokens, "gen_id": None, "acked": 0,
+                "consumed": [], "finished": False, "lost": False,
+            })
+        for c in clients:
+            with tenant_mod.bind(c["tenant"]):
+                reply = net.client(c["cid"]).call("L", "job.generate", {
+                    "model": "toy", "prompt": c["prompt"],
+                    "max_new_tokens": c["tokens"], "seed": c["seed"],
+                })
+            c["gen_id"] = reply["gen_id"]
+
+        # Seeded disruption schedule: kills and the drain land on distinct
+        # members at distinct rounds, each mid-decode.
+        rounds = sorted(rng.sample(range(2, 2 + 4 * (self.kills + self.drains)),
+                                   self.kills + self.drains))
+        events = (["kill"] * self.kills) + (["drain"] * self.drains)
+        rng.shuffle(events)
+        schedule = dict(zip(rounds, events))
+        disrupted: set[str] = set()
+        migration_budget = 0
+        drain_members: list[str] = []
+        drain_resident: set[str] = set()
+
+        def residents(member: str) -> list[str]:
+            return [s["id"] for s in router.sessions_table()
+                    if s["member"] == member
+                    and s["state"] in ("running", "migrating")]
+
+        done = 0
+        for rnd in range(self.max_rounds):
+            event = schedule.get(rnd)
+            if event is not None:
+                hosting = sorted(
+                    m for m in alive - disrupted
+                    if residents(m)
+                ) or sorted(alive - disrupted)
+                victim = rng.choice(hosting)
+                disrupted.add(victim)
+                migration_budget += len(residents(victim))
+                if event == "kill":
+                    alive.discard(victim)
+                    net.crash(victim)
+                else:
+                    drain_members.append(victim)
+                    drain_resident.update(residents(victim))
+                    router.drain(victim, reason="loadgen")
+            for m in sorted(alive):
+                decoders[m].step()
+            router.tick()
+            done = 0
+            for c in clients:
+                if c["finished"] or c["lost"]:
+                    done += 1
+                    continue
+                try:
+                    r = net.client(c["cid"]).call("L", "job.generate_poll", {
+                        "gen_id": c["gen_id"], "ack": c["acked"],
+                    })
+                except (RpcUnreachable, RpcError):
+                    continue
+                for seq, toks in sorted(r.get("chunks", [])):
+                    if seq <= c["acked"]:
+                        continue
+                    c["acked"] = seq
+                    c["consumed"].extend(int(t) for t in toks)
+                if r.get("done") and not r.get("chunks"):
+                    if r.get("error"):
+                        c["lost"] = True
+                    else:
+                        c["finished"] = True
+            if done == len(clients):
+                break
+
+        return self._certify(router, clients, migration_budget,
+                             drain_members, drain_resident)
+
+    def _certify(self, router: Any, clients: list[dict[str, Any]],
+                 migration_budget: int, drain_members: list[str],
+                 drain_resident: set[str]) -> dict[str, Any]:
+        migrations_by_sid = {
+            s["id"]: int(s["migrations"]) for s in router.sessions_table()
+        }
+        drains_doc = router.draining()
+        tenants: dict[str, dict[str, int]] = {}
+        completed = lost = duplicated = drain_lost = 0
+        max_migrations = 0
+        total_migrations = 0
+        for c in clients:
+            t = tenants.setdefault(c["tenant"], {
+                "streams": 0, "completed": 0, "lost": 0,
+                "duplicated": 0, "migrations": 0,
+            })
+            t["streams"] += 1
+            ok = c["finished"] and c["consumed"] == c["plan"]
+            dup = c["consumed"] != c["plan"][: len(c["consumed"])]
+            m = migrations_by_sid.get(c["gen_id"], 0)
+            completed += int(ok)
+            t["completed"] += int(ok)
+            if not ok:
+                lost += 1
+                t["lost"] += 1
+                if c["gen_id"] in drain_resident:
+                    drain_lost += 1
+            duplicated += int(dup)
+            t["duplicated"] += int(dup)
+            total_migrations += m
+            t["migrations"] += m
+            max_migrations = max(max_migrations, m)
+        certified = (
+            completed == len(clients) and lost == 0 and duplicated == 0
+            and total_migrations <= migration_budget and drain_lost == 0
+            and all(d.get("complete") for d in drains_doc.values())
+        )
+        return {
+            "version": SLO_CERT_VERSION,
+            "seed": self.seed,
+            "sessions": {
+                "members": self.n_members,
+                "streams": len(clients),
+                "completed": completed,
+                "lost": lost,
+                "duplicated": duplicated,
+                "kills": self.kills,
+                "drains": self.drains,
+                "migrations": total_migrations,
+                "migration_budget": migration_budget,
+                "max_migrations_per_stream": max_migrations,
+                "drain_completed": all(
+                    bool(d.get("complete")) for d in drains_doc.values()
+                ) if drains_doc else True,
+                "drain_lost": drain_lost,
+                "tenants": tenants,
+                "certified": certified,
+            },
+        }
+
+
+def session_churn_harness(
+    n_members: int, seed: int, **overrides: Any
+) -> SessionChurnHarness:
+    """SessionChurnHarness wired for the survivable-generation
+    certification: sixteen streams over two tenants on four members, two
+    seeded kills mid-decode and one drain (docs/GENERATE.md)."""
+    params: dict[str, Any] = dict(streams=16, kills=2, drains=1)
+    params.update(overrides)
+    return SessionChurnHarness(n_members, seed, **params)
+
+
+_SESSION_SHAPE: dict[str, tuple] = {
+    "members": (int,), "streams": (int,), "completed": (int,),
+    "lost": (int,), "duplicated": (int,), "kills": (int,),
+    "drains": (int,), "migrations": (int,), "migration_budget": (int,),
+    "max_migrations_per_stream": (int,), "drain_completed": (bool,),
+    "drain_lost": (int,), "tenants": (dict,), "certified": (bool,),
+}
+
+
+def validate_sessions(doc: dict) -> list[str]:
+    """The sessions section's invariants (optional section — absent on
+    certificates without generation churn): every verdict field present
+    and typed, completed + lost accounting for every stream, and the
+    per-tenant breakdown summing exactly to the fleet totals."""
+    body = doc.get("sessions")
+    if body is None:
+        return []
+    problems: list[str] = []
+    if not isinstance(body, dict):
+        return ["sessions section is not an object"]
+    for key, types in _SESSION_SHAPE.items():
+        if key not in body:
+            problems.append(f"sessions.{key} missing")
+        elif not isinstance(body[key], types) or (
+            isinstance(body[key], bool) and bool not in types
+        ):
+            problems.append(f"sessions.{key} has wrong type")
+    # Arithmetic invariants run only over well-typed fields: a tampered
+    # "zero" string is already reported above and must not crash the
+    # validator (it judges hostile docs, it doesn't trust them).
+    def num(v: Any) -> int:
+        return int(v) if isinstance(v, (int, float)) and \
+            not isinstance(v, bool) else 0
+
+    if num(body.get("completed")) + num(body.get("lost")) != \
+            num(body.get("streams")):
+        problems.append("sessions: completed + lost != streams")
+    tenants = body.get("tenants")
+    if isinstance(tenants, dict):
+        for name, tbody in tenants.items():
+            if not isinstance(tbody, dict):
+                problems.append(f"sessions.tenants.{name} is not an object")
+        for key in ("streams", "completed", "lost", "migrations"):
+            tallied = sum(
+                num(t.get(key)) for t in tenants.values()
+                if isinstance(t, dict)
+            )
+            if tallied != num(body.get(key)):
+                problems.append(
+                    f"sessions: tenant {key} total {tallied} != "
+                    f"fleet {key} {body.get(key)}"
+                )
+    return problems
+
+
 __all__ = [
     "ISOLATION_TENANTS",
     "SLO_CERT_VERSION",
@@ -1165,10 +1485,13 @@ __all__ = [
     "ModelTally",
     "OpenLoopArrivals",
     "ReplayHarness",
+    "SessionChurnHarness",
     "SimMember",
     "TrafficMix",
     "TrafficSpec",
+    "session_churn_harness",
     "tenant_isolation_harness",
     "two_tenant_flash_spec",
+    "validate_sessions",
     "validate_slo_cert",
 ]

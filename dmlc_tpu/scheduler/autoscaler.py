@@ -57,6 +57,13 @@ class ScaleTarget:
     lanes are matched on their model part, so the per-tenant composite
     ``llm-7b@acme`` drives a target registered for ``llm-7b``.
     ``memory_bound`` targets answer to the HBM guard on the way up.
+
+    ``drain`` gates the way DOWN (ISSUE 19, scale-down-through-drain): a
+    shrink that would abandon live work — generation slots mid-decode, a
+    member holding resident sessions — first asks ``drain(proposed)``.
+    True means the capacity is already clear and the shrink applies; False
+    means a drain was *initiated* (sessions finishing or migrating) and
+    the shrink holds, visibly, until a later quiet tick finds it clear.
     """
 
     def __init__(
@@ -69,6 +76,7 @@ class ScaleTarget:
         hi: int = 64,
         models: Iterable[str] | None = None,
         memory_bound: bool = False,
+        drain: Callable[[int], bool] | None = None,
     ) -> None:
         self.name = name
         self.get = get
@@ -77,6 +85,7 @@ class ScaleTarget:
         self.hi = int(hi)
         self.models = frozenset(models) if models is not None else None
         self.memory_bound = bool(memory_bound)
+        self.drain = drain
 
     def matches(self, burning_models: set[str]) -> bool:
         if self.models is None:
@@ -206,7 +215,17 @@ class Autoscaler:
                     continue
                 if moves >= self.moves_budget:
                     continue  # quiet shrink can always wait a tick
-                effective = int(target.apply(max(target.lo, cur - 1)))
+                proposed = max(target.lo, cur - 1)
+                if target.drain is not None and not target.drain(proposed):
+                    # Scale-down goes through drain, never through
+                    # abandonment: the seam started draining the excess
+                    # capacity; the shrink lands once it reports clear.
+                    out.append(self._record(
+                        target=target.name, direction="hold", at=cur,
+                        trigger=f"slo_clear:{streak}w", reason="draining",
+                    ))
+                    continue
+                effective = int(target.apply(proposed))
                 moves += 1
                 out.append(self._record(
                     target=target.name, direction="down",

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import logging
 import threading
+from collections.abc import Callable
 from dataclasses import dataclass, field
 from time import monotonic
 
@@ -312,6 +313,10 @@ class JobScheduler:
         # set at promotion; candidates compare terms to know who abdicates
         # after a candidate partition heals.
         self.epoch: list = [0, ""]
+        # Optional extra leader.status payload supplier (node wires the
+        # GenRouter's session/drain summary here) — a plain callable so
+        # this module stays ignorant of the generation plane.
+        self.extra_status: Callable[[], dict] | None = None
         self._lock = threading.RLock()
 
     # ---- RPC surface ---------------------------------------------------
@@ -327,6 +332,8 @@ class JobScheduler:
                 "leading": self.is_leading,
                 "epoch": list(self.epoch),
                 "overload": self.overload_status(),
+                **({"generate": self.extra_status()}
+                   if self.extra_status is not None else {}),
             },
         })
 

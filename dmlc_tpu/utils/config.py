@@ -308,6 +308,11 @@ class ClusterConfig:
     gen_max_waiting: int = 8
     # Streamed-chunk retention for a client that stopped polling.
     gen_session_ttl_s: float = 120.0
+    # Leader-routed sessions (scheduler/genrouter.py): ledger capacity and
+    # the default drain deadline — residents of a draining member get this
+    # long to finish before the tick loop migrates them.
+    gen_router_max_sessions: int = 256
+    gen_drain_deadline_s: float = 30.0
 
     # --- control-plane authentication (cluster/auth.py) ---
     # Shared fleet key: every RPC frame and gossip datagram carries an

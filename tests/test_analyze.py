@@ -1053,9 +1053,11 @@ def test_ratchet_accepts_committed_repo_baseline():
 
 
 def test_analyzer_runtime_budget():
-    """A1-A9 over the whole tree stays inside the 3s interactive budget
-    (pure AST, no imports — docs/ANALYZE.md)."""
+    """A1-A9 over the whole tree stays inside the 4s interactive budget
+    (pure AST, no imports — docs/ANALYZE.md). Raised from 3s with the
+    session-router tier (scheduler/genrouter.py), same as 2s -> 3s when
+    A9 landed: the budget tracks tree size, the analyzer stays pure-AST."""
     import time
     t0 = time.monotonic()
     run_rules(REPO / "dmlc_tpu")
-    assert time.monotonic() - t0 < 3.0
+    assert time.monotonic() - t0 < 4.0

@@ -180,6 +180,14 @@ for seed_base in 0 1000 2000; do
     note "tenant-isolation smoke $seed_base FAILED (replay: python tools/slo_cert.py --tenants --seed $seed_base --out /tmp/slo_cert_tenants_$seed_base.json)"
     fail=1
   fi
+  note "session-churn smoke DMLC_CHAOS_SEED=$seed_base (generate-heavy churn: seeded kills mid-stream + drain, exactly-once tokens, docs/GENERATE.md)"
+  if env JAX_PLATFORMS=cpu python tools/slo_cert.py --sessions --members 4 \
+      --seed "$seed_base" --out "/tmp/slo_cert_sessions_$seed_base.json"; then
+    note "session-churn smoke $seed_base OK (/tmp/slo_cert_sessions_$seed_base.json)"
+  else
+    note "session-churn smoke $seed_base FAILED (replay: python tools/slo_cert.py --sessions --members 4 --seed $seed_base --out /tmp/slo_cert_sessions_$seed_base.json)"
+    fail=1
+  fi
   note "gang smoke DMLC_CHAOS_SEED=$seed_base (sharded predict vs mesh-of-1 reference at 3 and 8 virtual devices, docs/SHARDING.md)"
   if env DMLC_CHAOS_SEED="$seed_base" python -c \
       "import __graft_entry__ as g; g.gang_smoke(3); g.gang_smoke(8)"; then
@@ -194,7 +202,7 @@ for seed_base in 0 1000 2000; do
       tests/test_generate_cluster.py tests/test_placement.py \
       tests/test_scrapetree.py tests/test_loadgen.py \
       tests/test_decodetier.py tests/test_tenant.py \
-      tests/test_autoscaler.py \
+      tests/test_autoscaler.py tests/test_genrouter.py \
       -q -p no:cacheprovider; then
     note "chaos leg $seed_base OK"
   else

@@ -32,7 +32,7 @@ from tools.mc.shrink import shrink
 #: per-scenario exhaustive caps for the CI leg: generous next to the
 #: observed tree sizes, hard stops if a seam change blows a tree up
 CI_EXHAUSTIVE = ("breaker", "sdfs_put_crash_heal", "generate_ack",
-                 "tenant_quota")
+                 "tenant_quota", "session_migrate")
 CI_MAX_SCHEDULES = 60_000
 CI_TIME_BUDGET_S = 120.0
 CI_WALKS = 150

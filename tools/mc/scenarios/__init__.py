@@ -46,6 +46,7 @@ def _load() -> None:
         breaker,
         generate,
         membership,
+        migrate,
         quota,
         sdfs,
     )

@@ -82,7 +82,9 @@ class _ToyBackend:
 
     def submit(self, prompt: list[int], *, max_new_tokens: int,
                temperature: float = 0.0, eos_id: int | None = None,
-               request_id: str = "") -> GenStream:
+               request_id: str = "", seed: int | None = None,
+               resume_tokens: list[int] | None = None) -> GenStream:
+        del seed, resume_tokens  # ack scenario never resumes; see migrate.py
         stream = self.stream_cls(request_id)
         self.monitor.instrument(stream, "_cv")
         plan = [int(prompt[0]) * 100 + i + 1 for i in range(int(max_new_tokens))]

@@ -1,0 +1,368 @@
+"""Session-migrate scenario: a generation stream survives its member.
+
+A real :class:`GenRouter` (scheduler/genrouter.py) fronts two real
+``GenerateWorker`` members on a ``SimRpcNetwork``; the backends are toy
+deterministic decoders whose plan is a pure function of (prompt, seed,
+position) — exactly the contract the engine's position-seeded sampling
+provides — so a resume-from-prefix submit on a survivor continues the SAME
+token sequence. The model checker interleaves:
+
+- ``submit:cX`` / ``poll:cX`` / ``poll_dup:cX`` — clients running the
+  ``generate_stream`` consume protocol against the ROUTER. Duplicate
+  delivery is injected only on ``job.generate_poll`` (the one verb of the
+  pair in ``IDEMPOTENT_VERBS``); the world refuses to build if it leaves
+  the registry.
+- ``step:mY``       — one decode tick on one member.
+- ``crash:m0``      — the fabric kills member m0 mid-decode (once).
+- ``tick``          — the leader's migration loop: detects the dead member
+  and re-prefills prompt+delivered on the survivor.
+- ``cancel:c1``     — client-initiated cancel racing everything else.
+- ``failover``      — the standby router adopts the leader's epoch-keyed
+  ``gen.state`` wire and promotes; every later client call lands on the
+  new leader (once).
+
+Invariants (ISSUE 19):
+
+- ``token-prefix-exactly-once`` — every client's consumed tokens are a
+  prefix of its deterministic plan, and a finished client consumed its
+  plan exactly: nothing lost, nothing doubled, across crash + migration +
+  duplicate polls + failover.
+- ``no-session-adopted-twice``  — a session id is prefilled at most
+  ``1 + crashes`` times across ALL members (the member-side gen_id dedup
+  plus the router's single-flight ``migrating`` state), and adoption
+  after failover never forks a sid into two placements.
+- ``ledger-matches-delivered``  — the active router's ledger prefix for a
+  session always covers what its client consumed (the ledger is what
+  migration re-prefills — a gap here is a future lost token).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from dmlc_tpu.cluster.rpc import (
+    IDEMPOTENT_VERBS,
+    MC_DELIVER,
+    MC_DUPLICATE,
+    RpcError,
+    RpcUnreachable,
+    SimRpcNetwork,
+)
+from dmlc_tpu.generate.slots import GenStream
+from dmlc_tpu.generate.worker import GenerateWorker
+from dmlc_tpu.scheduler.genrouter import GenRouter
+from tools.mc.core import Event, InvariantViolation
+from tools.mc.locks import LockMonitor
+from tools.mc.scenarios import register
+
+# Router lock is outermost (its RPCs happen lock-free by design, but the
+# hierarchy must still be explicit), worker lock next, stream cv leaf —
+# matching dmlc-analyze's static lock graph for the generation tier.
+LOCK_LEVELS = {
+    "dmlc_tpu.scheduler.genrouter.GenRouter._lock": 5,
+    "dmlc_tpu.generate.worker.GenerateWorker._lock": 10,
+    "dmlc_tpu.generate.slots.GenStream._cv": 20,
+}
+
+
+def _plan(prompt: list[int], seed: int, n: int) -> list[int]:
+    """The toy decoder's full output: token i is a pure function of
+    (prompt, seed, i) — the migration token-identity contract."""
+    return [int(prompt[0]) * 1000 + int(seed) * 100 + i + 1 for i in range(n)]
+
+
+class _ToyBackend:
+    """Deterministic GenerationBackend stand-in with the resume-from-prefix
+    entry: ``resume_tokens`` skips the already-delivered positions, so a
+    migrated stream continues token-identically."""
+
+    def __init__(self, member: str, monitor: LockMonitor,
+                 prefills: dict[str, int]):
+        self.member = member
+        self.monitor = monitor
+        self.prefills = prefills  # shared across members: sid -> count
+        self.live: list[tuple[GenStream, list[int]]] = []
+
+    def submit(self, prompt: list[int], *, max_new_tokens: int,
+               temperature: float = 0.0, eos_id: int | None = None,
+               request_id: str = "", seed: int | None = None,
+               resume_tokens: Any = None) -> GenStream:
+        stream = GenStream(request_id)
+        self.monitor.instrument(stream, "_cv")
+        done = [int(t) for t in resume_tokens] if resume_tokens else []
+        full = _plan(prompt, seed or 0, len(done) + int(max_new_tokens))
+        remaining = full[len(done):]
+        self.prefills[request_id] = self.prefills.get(request_id, 0) + 1
+        self.live.append((stream, remaining))
+        return stream
+
+    def step(self) -> None:
+        for stream, remaining in self.live:
+            if stream.done or stream.cancelled:
+                continue
+            if remaining:
+                stream.push([remaining.pop(0)])
+            if not remaining:
+                stream.finish()
+
+    def busy(self) -> bool:
+        return any(not s.done and not s.cancelled and r
+                   for s, r in self.live)
+
+
+class _Client:
+    """generate_stream's consume protocol as explicit world state."""
+
+    def __init__(self, cid: str, prompt: int, seed: int, tokens: int):
+        self.cid = cid
+        self.prompt = [prompt]
+        self.seed = seed
+        self.plan = _plan(self.prompt, seed, tokens)
+        self.gen_id: str | None = None
+        self.acked = 0
+        self.consumed: list[int] = []
+        self.finished = False
+        self.cancelled = False
+
+
+class _World:
+    def __init__(self) -> None:
+        for verb in ("job.generate_poll",):
+            if verb not in IDEMPOTENT_VERBS:
+                raise RuntimeError(
+                    f"{verb} left IDEMPOTENT_VERBS; duplicate-delivery "
+                    "injection on it is no longer legal (docs/MODELCHECK.md)"
+                )
+        self.net = SimRpcNetwork()
+        self.monitor = LockMonitor(levels=LOCK_LEVELS)
+        self.prefills: dict[str, int] = {}
+        self.members = ["m0", "m1"]
+        self.alive = set(self.members)
+        self.backends: dict[str, _ToyBackend] = {}
+        for m in self.members:
+            backend = _ToyBackend(m, self.monitor, self.prefills)
+            worker = GenerateWorker(
+                {"toy": backend},  # type: ignore[dict-item]
+                session_ttl_s=1e9, clock=self.net.clock,
+            )
+            self.monitor.instrument(worker, "_lock")
+            self.backends[m] = backend
+            self.net.serve(m, worker.methods())
+
+        def router(addr: str) -> GenRouter:
+            r = GenRouter(
+                self.net.client(addr),
+                lambda: sorted(self.alive),
+                timeout_s=5.0,
+                session_ttl_s=1e9,
+                clock=self.net.clock,
+            )
+            self.monitor.instrument(r, "_lock")
+            self.net.serve(addr, r.methods())
+            return r
+
+        self.leader = router("L0")
+        self.leader.is_leading = True
+        self.leader.epoch = [1, "L0"]
+        self.standby = router("L1")
+        self.router_addr = "L0"
+        self.clients = {
+            "c0": _Client("c0", prompt=1, seed=3, tokens=3),
+            "c1": _Client("c1", prompt=2, seed=4, tokens=2),
+        }
+        self.budgets = {
+            ("c0", "poll"): 4, ("c0", "poll_dup"): 1,
+            ("c1", "poll"): 3, ("c1", "poll_dup"): 1,
+        }
+        self.step_budget = {"m0": 3, "m1": 6}
+        self.crash_budget = 1
+        self.tick_budget = 2
+        self.cancel_budget = 1
+        self.failover_budget = 1
+        self._mc_action = MC_DELIVER
+
+    def _active(self) -> GenRouter:
+        return self.leader if self.router_addr == "L0" else self.standby
+
+    # ---- fabric hook ------------------------------------------------------
+
+    def _hook(self, source: str, addr: str, method: str) -> str:
+        action, self._mc_action = self._mc_action, MC_DELIVER
+        return action
+
+    # ---- events -----------------------------------------------------------
+
+    def enabled(self) -> list[Event]:
+        everyone = frozenset(self.clients)
+        out: list[Event] = []
+        for cid, c in sorted(self.clients.items()):
+            foot = frozenset({cid})
+            if c.gen_id is None:
+                out.append(Event(
+                    f"submit:{cid}", (lambda c=c: self._submit(c)), foot,
+                ))
+                continue
+            if c.finished or c.cancelled:
+                continue
+            for kind in ("poll", "poll_dup"):
+                if self.budgets.get((cid, kind), 0) > 0:
+                    out.append(Event(
+                        f"{kind}:{cid}",
+                        (lambda c=c, k=kind: self._poll(c, k)), foot,
+                    ))
+        for m, backend in sorted(self.backends.items()):
+            if m in self.alive and self.step_budget[m] > 0 and backend.busy():
+                out.append(Event(
+                    f"step:{m}", (lambda m=m: self._step(m)), everyone,
+                ))
+        started = any(c.gen_id is not None for c in self.clients.values())
+        if self.crash_budget > 0 and started and "m0" in self.alive:
+            out.append(Event("crash:m0", self._crash, everyone))
+        if self.tick_budget > 0 and started:
+            out.append(Event("tick", self._tick, everyone))
+        if self.cancel_budget > 0:
+            c1 = self.clients["c1"]
+            if c1.gen_id is not None and not c1.finished and not c1.cancelled:
+                out.append(Event(
+                    "cancel:c1", self._cancel_c1, frozenset({"c1"}),
+                ))
+        if self.failover_budget > 0 and started:
+            out.append(Event("failover", self._failover, everyone))
+        return out
+
+    def _submit(self, c: _Client) -> None:
+        reply = self.net.client(c.cid).call(
+            self.router_addr, "job.generate",
+            {"model": "toy", "prompt": c.prompt,
+             "max_new_tokens": len(c.plan), "seed": c.seed},
+        )
+        c.gen_id = reply["gen_id"]
+
+    def _poll(self, c: _Client, kind: str) -> None:
+        self.budgets[(c.cid, kind)] -= 1
+        self.net.mc_hook = self._hook
+        self._mc_action = MC_DUPLICATE if kind == "poll_dup" else MC_DELIVER
+        try:
+            r = self.net.client(c.cid).call(
+                self.router_addr, "job.generate_poll",
+                {"gen_id": c.gen_id, "ack": c.acked},
+            )
+        except (RpcUnreachable, RpcError):
+            return  # mid-failover/lost: the ack must not move
+        finally:
+            self.net.mc_hook = None
+            self._mc_action = MC_DELIVER
+        for seq, toks in sorted(r.get("chunks", [])):
+            if seq <= c.acked:
+                continue
+            c.acked = seq
+            c.consumed.extend(int(t) for t in toks)
+        if r.get("done") and not r.get("chunks"):
+            if not r.get("error"):
+                c.finished = True
+            else:
+                c.cancelled = True  # cancelled / lost verdict: stop polling
+
+    def _step(self, m: str) -> None:
+        self.step_budget[m] -= 1
+        self.backends[m].step()
+
+    def _crash(self) -> None:
+        self.crash_budget -= 1
+        self.alive.discard("m0")
+        self.net.crash("m0")
+
+    def _tick(self) -> None:
+        self.tick_budget -= 1
+        self._active().tick()
+
+    def _cancel_c1(self) -> None:
+        self.cancel_budget -= 1
+        c = self.clients["c1"]
+        try:
+            self.net.client("c1").call(
+                self.router_addr, "job.generate_cancel", {"gen_id": c.gen_id},
+            )
+        except (RpcUnreachable, RpcError):
+            return
+        c.cancelled = True
+
+    def _failover(self) -> None:
+        """The standby adopts the leader's wire (its sync loop) and
+        promotes; the old leader abdicates. Adoption is driven TWICE to
+        pin idempotency — a re-adopt must not fork or rewind sessions."""
+        self.failover_budget -= 1
+        wire = self.leader.to_wire()
+        self.standby.adopt_state(wire)
+        self.standby.adopt_state(wire)  # idempotent re-adopt
+        self.leader.is_leading = False
+        self.standby.is_leading = True
+        self.standby.epoch = [2, "L1"]
+        self.standby.readopt()
+        self.router_addr = "L1"
+
+    # ---- invariants -------------------------------------------------------
+
+    def _check_prefix(self) -> None:
+        for c in self.clients.values():
+            if c.consumed != c.plan[: len(c.consumed)]:
+                raise InvariantViolation(
+                    "token-prefix-exactly-once",
+                    f"{c.cid} consumed {c.consumed}, not a prefix of plan "
+                    f"{c.plan} (duplicated, reordered, or forked token)",
+                )
+            if c.finished and c.consumed != c.plan:
+                raise InvariantViolation(
+                    "token-prefix-exactly-once",
+                    f"{c.cid} finished with {c.consumed}, plan {c.plan} "
+                    f"(token(s) lost)",
+                )
+
+    def _check_single_adoption(self) -> None:
+        kills = 1 - self.crash_budget
+        for c in self.clients.values():
+            if c.gen_id is None:
+                continue
+            n = self.prefills.get(c.gen_id, 0)
+            if n > 1 + kills:
+                raise InvariantViolation(
+                    "no-session-adopted-twice",
+                    f"{c.cid} session {c.gen_id} prefilled {n}x with "
+                    f"{kills} kill(s) — a placement was forked",
+                )
+
+    def _check_ledger(self) -> None:
+        table = {s.sid: s for s in self._active()._sessions.values()}
+        for c in self.clients.values():
+            if c.gen_id is None or c.cancelled:
+                continue
+            s = table.get(c.gen_id)
+            if s is None:
+                continue  # retired after completion: nothing left to cover
+            if s.delivered[: len(c.consumed)] != c.consumed:
+                raise InvariantViolation(
+                    "ledger-matches-delivered",
+                    f"{c.cid} consumed {c.consumed} but the ledger holds "
+                    f"{s.delivered} — migration would re-prefill a fork",
+                )
+
+    def invariants(self) -> list[tuple[str, Callable[[], None]]]:
+        return [
+            ("token-prefix-exactly-once", self._check_prefix),
+            ("no-session-adopted-twice", self._check_single_adoption),
+            ("ledger-matches-delivered", self._check_ledger),
+            ("lock-hierarchy", self.monitor.check),
+        ]
+
+    def close(self) -> None:
+        self.net.mc_hook = None
+
+
+class _SessionMigrateScenario:
+    name = "session_migrate"
+
+    def build(self) -> _World:
+        return _World()
+
+
+register(_SessionMigrateScenario())

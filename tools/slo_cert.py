@@ -76,6 +76,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "the surging tenant shed typed over-quota, the "
                          "steady tenant's p99 certified, zero cross-tenant "
                          "evictions, and autoscaler convergence")
+    ap.add_argument("--sessions", action="store_true",
+                    help="run the pinned generate-heavy churn scenario "
+                         "(real session router + workers, seeded member "
+                         "kills mid-stream + one drain) and certify the "
+                         "sessions section: zero lost/duplicated tokens, "
+                         "migrations bounded, drain drops nothing")
     ap.add_argument("--out", default="slo_cert.json",
                     help="certificate path (default ./slo_cert.json)")
     return ap
@@ -114,6 +120,69 @@ def tenant_failures(doc: dict) -> list[str]:
     return failures
 
 
+def session_failures(doc: dict) -> list[str]:
+    """The survivable-generation verdicts ci_check's sessions leg gates
+    on — shared with tests/test_genrouter.py so CI and pytest pin the
+    same story (docs/GENERATE.md)."""
+    failures: list[str] = []
+    s = doc.get("sessions") or {}
+    if s.get("completed") != s.get("streams"):
+        failures.append(
+            f"only {s.get('completed')}/{s.get('streams')} streams "
+            "completed token-identical to their unkilled reference"
+        )
+    if s.get("lost", 1):
+        failures.append(f"{s.get('lost')} session(s) lost tokens or died")
+    if s.get("duplicated", 1):
+        failures.append(f"{s.get('duplicated')} session(s) saw a "
+                        "duplicated or forked token")
+    if s.get("migrations", 0) > s.get("migration_budget", 0):
+        failures.append(
+            f"{s.get('migrations')} migrations exceed the "
+            f"{s.get('migration_budget')} sessions resident at the "
+            "kills/drains — a stream was re-prefilled without cause"
+        )
+    if not s.get("drain_completed"):
+        failures.append("the drain never completed")
+    if s.get("drain_lost", 1):
+        failures.append(f"{s.get('drain_lost')} session(s) resident on "
+                        "the drained member were dropped")
+    for name, t in sorted((s.get("tenants") or {}).items()):
+        if t.get("lost") or t.get("duplicated"):
+            failures.append(
+                f"tenant {name!r} lost={t.get('lost')} "
+                f"duplicated={t.get('duplicated')} — churn leaked across "
+                "the tenant boundary"
+            )
+    return failures
+
+
+def _sessions_main(args) -> int:
+    from dmlc_tpu.loadgen import session_churn_harness, validate_sessions
+
+    doc = session_churn_harness(args.members, args.seed).run()
+    out = Path(args.out)
+    out.write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+    failures = [f"schema: {p}" for p in validate_sessions(doc)]
+    failures.extend(f"sessions: {f}" for f in session_failures(doc))
+    s = doc["sessions"]
+    print(f"slo_cert: {s['streams']} generation streams over "
+          f"{s['members']} members, {s['kills']} kill(s) + "
+          f"{s['drains']} drain(s): completed={s['completed']} "
+          f"lost={s['lost']} duplicated={s['duplicated']} "
+          f"migrations={s['migrations']}/{s['migration_budget']} budget "
+          f"drain_lost={s['drain_lost']} -> {out}")
+    for name, t in sorted(s["tenants"].items()):
+        print(f"  tenant {name:<8} streams={t['streams']} "
+              f"completed={t['completed']} lost={t['lost']} "
+              f"duplicated={t['duplicated']} migrations={t['migrations']}")
+    if failures:
+        for f in failures:
+            print(f"slo_cert FAIL: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     from dmlc_tpu.loadgen import (
         ReplayHarness,
@@ -123,6 +192,8 @@ def main(argv=None) -> int:
     )
 
     args = build_parser().parse_args(argv)
+    if args.sessions:
+        return _sessions_main(args)
     if args.tenants:
         from dmlc_tpu.loadgen import tenant_isolation_harness
 

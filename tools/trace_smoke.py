@@ -76,6 +76,63 @@ def main() -> int:
         # request's rpc/job.generate span.
         gen_reply = leader.generate("lm_small", [1, 2, 3], max_new_tokens=4)
         assert len(gen_reply["tokens"]) == 4, gen_reply
+
+        # Survivable-generation contract (docs/GENERATE.md §Migration): a
+        # ROUTED generate drained off its member mid-stream must keep ONE
+        # trace id across the migration — gen/* spans from two distinct
+        # member lanes parented into the leader's rpc/job.generate trace.
+        router = leader.genrouter
+        assert router is not None, "promoted leader has no session router"
+        mig_reply = leader.rpc.call(
+            leader.tracker.current, "job.generate",
+            {"model": "lm_small", "prompt": [4, 5], "max_new_tokens": 48,
+             "seed": 11},
+            timeout=30.0,
+        )
+        mig_gen_id = mig_reply["gen_id"]
+        mig_tokens: list[int] = []
+        mig_acked = 0
+
+        def _poll_once() -> dict:
+            nonlocal mig_acked
+            r = leader.rpc.call(
+                leader.tracker.current, "job.generate_poll",
+                {"gen_id": mig_gen_id, "ack": mig_acked}, timeout=30.0,
+            )
+            for seq, chunk in sorted(r.get("chunks", [])):
+                if seq <= mig_acked:
+                    continue
+                mig_acked = seq
+                mig_tokens.extend(int(t) for t in chunk)
+            return r
+
+        wait_until(
+            lambda: bool(_poll_once() and mig_tokens),
+            timeout=60.0, msg="first routed token before the drain",
+        )
+        placed = next(s["member"] for s in router.sessions_table()
+                      if s["id"] == mig_gen_id)
+        router.drain(placed, deadline_s=0.0, reason="trace_smoke")
+        wait_until(
+            lambda: (router.tick() or True) and any(
+                s["id"] == mig_gen_id and s["migrations"] >= 1
+                for s in router.sessions_table()
+            ),
+            timeout=30.0, msg="drained session migrated",
+        )
+        wait_until(
+            lambda: bool((r := _poll_once()).get("done")
+                         and not r.get("chunks")),
+            timeout=60.0, msg="migrated stream finished",
+        )
+        assert len(mig_tokens) == 48, (
+            f"{len(mig_tokens)} tokens across the migration (want exactly "
+            "48: a shortfall is a lost token, an excess a duplicate)"
+        )
+        mig_wire = router._sessions[mig_gen_id].trace
+        mig_trace = mig_wire[0] if mig_wire else None
+        router.undrain(placed)
+
         out = tmp / "fleet_trace.json"
         observe.export_fleet_trace(
             leader.rpc, sorted(leader.active_member_addrs()), out
@@ -177,10 +234,29 @@ def main() -> int:
             file=sys.stderr,
         )
         return 1
+    # Migration contract: the drained generate's trace must hold gen/*
+    # spans from >= 2 member lanes AND its rpc/job.generate root — one
+    # trace id surviving the mid-stream move between members.
+    mig_events = [e for e in events if e["args"].get("trace") == mig_trace]
+    mig_gen_pids = {e["pid"] for e in mig_events
+                    if e["name"].startswith("gen/")}
+    mig_has_root = any(e["name"] == "rpc/job.generate" for e in mig_events)
+    if mig_trace is None or len(mig_gen_pids) < 2 or not mig_has_root:
+        print(
+            "trace smoke FAILED: migrated generate's trace "
+            f"{mig_trace!r} has gen/* spans on {len(mig_gen_pids)} member "
+            f"lane(s) (want >= 2) with rpc/job.generate root "
+            f"present={mig_has_root} — the migration forked or dropped "
+            "the trace",
+            file=sys.stderr,
+        )
+        return 1
     print(
         f"trace smoke OK: {len(events)} spans, {len(by_trace)} traces, "
         f"{len(multi_node)} crossing >= 2 nodes, "
         f"{len(gen_steps)} parented gen/step span(s), "
+        f"migrated generate across {len(mig_gen_pids)} member lanes "
+        "on one trace, "
         f"profile lanes for {len(profile_members)} members, "
         f"device-plane gauges for {len(device_members)} members"
     )
