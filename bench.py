@@ -272,6 +272,43 @@ def annotate_e2e(e2e: dict | None, old_e2e: dict | None) -> dict | None:
     return e2e
 
 
+def annotate_critpath_entries(
+    section: dict | None, old_section: dict | None
+) -> dict | None:
+    """Guard + history merge for the e2e leg's critical-path breakdown
+    (``bench_detail.json["critpath"]``, cluster/critpath.py). A model's
+    lane shares must sum to ~1 of its charged critical-path time — a sum
+    off by more than rounding marks the section malformed instead of
+    letting a broken extraction masquerade as attribution. Against the
+    previous artifact, a change of the DOMINANT lane (the bottleneck
+    moving, say decode -> dispatch) is stamped machine-visibly so a
+    BENCH_r*.json diff names the handoff. Returns None when this run
+    captured nothing (merge_detail keeps the old section, stamped stale)."""
+    if not section:
+        return None
+    section = dict(section)
+    models = dict(section.get("models") or {})
+    section["models"] = models
+    old_models = (old_section or {}).get("models") or {}
+    for model, body in models.items():
+        body = dict(body or {})
+        models[model] = body
+        lanes = body.get("lanes") or []
+        total = sum(float((ln or {}).get("share") or 0.0) for ln in lanes)
+        if lanes and abs(total - 1.0) > 1e-3:
+            body["malformed"] = True
+            section["malformed"] = True
+        if lanes:
+            top = lanes[0]
+            body["top_lane"] = f"{top.get('stage')}@{top.get('member')}"
+        prev_top = (old_models.get(model) or {}).get("top_lane")
+        if prev_top and body.get("top_lane") \
+                and prev_top != body["top_lane"]:
+            body["prev_top_lane"] = prev_top
+            body["bottleneck_shifted"] = True
+    return section
+
+
 def annotate_train_entries(train: dict, old_train: dict) -> dict:
     """Train-section guard — the last unguarded one (round 4: a degraded
     window wrote lm_flash_train 2.8k tok/s over the healthy 88k). PER-CHIP
@@ -508,6 +545,16 @@ def merge_detail(new: dict, old: dict) -> dict:
         out["device"] = new_dev
     elif old_dev:
         out["device"] = dict(old_dev, stale=True)
+
+    # critpath: like device, one coherent attribution of a single e2e leg —
+    # lanes from different runs can't be mixed (shares sum to 1 within ONE
+    # capture), so a fresh capture replaces the section wholesale and a run
+    # that captured none keeps the previous one stamped stale.
+    new_cp, old_cp = new.get("critpath"), old.get("critpath")
+    if new_cp:
+        out["critpath"] = new_cp
+    elif old_cp:
+        out["critpath"] = dict(old_cp, stale=True)
 
     out["history_best"] = update_history_best(
         old.get("history_best") or {}, list(new_configs) + curve_fresh
@@ -1364,6 +1411,7 @@ def bench_e2e(
     # instead of just observed at the headline.
     e2e_s = serial_s = stage_seconds = span_aggregates = profile_snapshot = None
     tier_stats = None
+    critpath_section = None
     if time_left() > 0:
         from dmlc_tpu.cluster.decodetier import DecodeTierClient
         from dmlc_tpu.utils.tracing import tracer
@@ -1405,6 +1453,29 @@ def bench_e2e(
         profiler = CostProfiler(window_s=60.0, windows=4)
         profiler.ingest_scrape("local", {"spans": tracer.summary()})
         profile_snapshot = profiler.snapshot()
+        # The same raw spans, reconstructed per request and charged along
+        # each request's BLOCKING chain only (cluster/critpath.py):
+        # overlapped prefetch decodes are concurrency, not cost, so this
+        # names the stage actually gating e2e_img_s — the attribution
+        # record bench_detail.json["critpath"] commits.
+        from dmlc_tpu.cluster.critpath import breakdown, spans_from_wire
+
+        crit = breakdown(spans_from_wire(tracer.events_wire()))
+        if crit:
+            critpath_section = {"models": {
+                (m if m else model): {
+                    "requests": body["requests"],
+                    "total_s": round(float(body["total_s"]), 4),
+                    "max_lanes": body["max_lanes"],
+                    "lanes": [
+                        {"stage": ln["stage"], "member": ln["member"],
+                         "crit_s": round(float(ln["crit_s"]), 6),
+                         "share": round(float(ln["share"]), 6)}
+                        for ln in body["lanes"]
+                    ],
+                }
+                for m, body in crit.items()
+            }}
         tracer.reset()
         ing = engine.ingest_summary()
         stage_seconds = {
@@ -1464,6 +1535,10 @@ def bench_e2e(
         # (docs/OBSERVABILITY.md §5): the lanes a cluster's placement loop
         # would see for this workload, grown from the identical scrape path.
         "profile": profile_snapshot,
+        # Per-request critical-path breakdown of the same spans (popped out
+        # into bench_detail.json["critpath"] by main; docs/OBSERVABILITY.md
+        # §9): blocking-chain attribution, not busy-time totals.
+        "critpath": critpath_section,
     }
 
 
@@ -1659,18 +1734,20 @@ def main() -> None:
     devlegs.end("configs")
 
     e2e = None
+    critpath = None
     if args.e2e and not over_budget("e2e"):
         devlegs.begin("e2e")
         try:
-            e2e = annotate_e2e(
-                bench_e2e(
-                    head["model"],
-                    base_batch,
-                    args.corpus,
-                    deadline=time.monotonic() + CAPS["e2e"],
-                ),
-                prev_detail.get("e2e"),
+            e2e_raw = bench_e2e(
+                head["model"],
+                base_batch,
+                args.corpus,
+                deadline=time.monotonic() + CAPS["e2e"],
             )
+            critpath = annotate_critpath_entries(
+                e2e_raw.pop("critpath", None), prev_detail.get("critpath")
+            )
+            e2e = annotate_e2e(e2e_raw, prev_detail.get("e2e"))
             print(
                 f"[bench-e2e] {e2e['model']} images={e2e['images']} "
                 f"decode_only={e2e['decode_only_img_s']} img/s "
@@ -1686,6 +1763,16 @@ def main() -> None:
                 print(
                     "[bench-e2e] stage breakdown (busy seconds): "
                     + " ".join(f"{k}={stages[k]}" for k in sorted(stages)),
+                    file=sys.stderr,
+                )
+            for m, body in ((critpath or {}).get("models") or {}).items():
+                lanes = " ".join(
+                    f"{ln['stage']}@{ln['member']}={ln['share'] * 100:.1f}%"
+                    for ln in body.get("lanes", [])[:4]
+                )
+                shifted = " BOTTLENECK-SHIFTED" if body.get("bottleneck_shifted") else ""
+                print(
+                    f"[bench-e2e] critical path {m}: {lanes}{shifted}",
                     file=sys.stderr,
                 )
         except Exception as e:
@@ -1892,6 +1979,7 @@ def main() -> None:
         "captured_at": round(time.time(), 1),
         "configs": results,
         "e2e": e2e,
+        "critpath": critpath,
         "batch_curve": curve,
         "flash": flash,
         "train": train,

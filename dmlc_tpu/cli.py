@@ -146,8 +146,17 @@ Commands (reference: README.md:10-23):
                                         the leader's holds the whole fleet
                                         (flags: --model M, --top K busiest
                                         lanes, --worst K slowest-p99 lanes)
-  slo                                   per-model SLO burn rates + the current
+  slo                                   per-model SLO burn rates, each lane's
+                                        critical-path culprit, + the current
                                         placement plan (leader's evaluator)
+  critpath [model] [--top K]            fleet critical-path attribution
+                                        (leader's fold): per model the
+                                        (stage x member) lanes ranked by
+                                        charged seconds, share of the
+                                        model's critical-path time,
+                                        p50/p99 self-time, and the drift
+                                        sentinel's verdict per lane
+                                        (docs/OBSERVABILITY.md section 9)
   tenants                               tenant table: declared priorities and
                                         shares, per-gate occupancy/quota/debt,
                                         per-tenant burn lanes (leader's
@@ -691,6 +700,62 @@ class Cli:
             return format_table(
                 ["model", "member", "stage", "n", "mean", "p50", "p99", "qps"], rows
             )
+        if cmd == "critpath":
+            # The leader's folded critical-path table: where each model's
+            # request time actually goes, lane by (stage, member), with
+            # the drift sentinel's per-lane verdict alongside.
+            opts = list(args)
+            try:
+                top = pop_option(opts, "--top", int)
+            except ValueError as e:
+                return str(e)
+            if len(opts) > 1:
+                return "usage: critpath [model] [--top K]"
+            model_filter = opts[0] if opts else None
+            try:
+                reply = n.rpc.call(
+                    n.tracker.current, "obs.critpath", {}, timeout=5.0
+                )
+            except Exception as e:
+                return f"leader critpath unavailable: {e}"
+            table = reply.get("critpath") or {}
+            sentinel = reply.get("sentinel") or {}
+            drifting = {
+                (ln.get("model"), ln.get("stage"), ln.get("member"))
+                for ln in sentinel.get("lanes", ())
+                if ln.get("alert")
+            }
+            rows = []
+            for model, body in sorted((table.get("models") or {}).items()):
+                if model_filter is not None and model != model_filter:
+                    continue
+                lanes = body.get("lanes") or []
+                if top is not None:
+                    lanes = lanes[:top]
+                for ln in lanes:
+                    p50, p99 = ln.get("p50"), ln.get("p99")
+                    rows.append([
+                        model, ln.get("stage"), ln.get("member"),
+                        f"{float(ln.get('crit_s') or 0.0):.3f}s",
+                        f"{float(ln.get('share') or 0.0) * 100:.1f}%",
+                        f"{p50 * 1e3:.1f}ms"
+                        if isinstance(p50, (int, float)) else "-",
+                        f"{p99 * 1e3:.1f}ms"
+                        if isinstance(p99, (int, float)) else "-",
+                        ln.get("n", 0),
+                        "DRIFT" if (model, ln.get("stage"), ln.get("member"))
+                        in drifting else "",
+                    ])
+            if not rows:
+                if model_filter is not None:
+                    return f"no critical-path lanes for model {model_filter!r}"
+                return ("no critical-path lanes yet (lanes grow as sampled "
+                        "request traces are charged on the scrape cycle)")
+            return format_table(
+                ["model", "stage", "member", "crit", "share", "p50", "p99",
+                 "n", "state"],
+                rows,
+            )
         if cmd == "slo":
             try:
                 reply = n.rpc.call(n.tracker.current, "obs.slo", {}, timeout=5.0)
@@ -711,6 +776,7 @@ class Cli:
                 rows = []
                 for model, s in sorted(models.items()):
                     p99 = s.get("p99_s")
+                    culprit = s.get("culprit") or {}
                     rows.append([
                         model,
                         f"{s['objective_latency_s'] * 1e3:.0f}ms"
@@ -720,9 +786,13 @@ class Cli:
                         f"{s['slow_burn']:.2f}x",
                         "FAST-BURN" if s.get("fast_alert")
                         else ("slow-burn" if s.get("slow_alert") else "ok"),
+                        f"{culprit.get('stage')}@{culprit.get('member')} "
+                        f"{float(culprit.get('critpath_share') or 0.0) * 100:.0f}%"
+                        if culprit else "-",
                     ])
                 out.append(format_table(
-                    ["model", "objective", "p99", "fast burn", "slow burn", "state"],
+                    ["model", "objective", "p99", "fast burn", "slow burn",
+                     "state", "culprit"],
                     rows,
                 ))
             placement = reply.get("placement") or {}

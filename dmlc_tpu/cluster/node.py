@@ -38,7 +38,9 @@ from dmlc_tpu.cluster.failover import LeaderTracker, StandbyLeader
 from dmlc_tpu.cluster.flight import FlightRecorder
 from dmlc_tpu.cluster.membership import MembershipNode
 from dmlc_tpu.cluster.observe import ObsService
+from dmlc_tpu.cluster.critpath import CritPathAnalyzer, FleetCritPath
 from dmlc_tpu.cluster.profile import CostProfiler
+from dmlc_tpu.cluster.sentinel import DriftSentinel
 from dmlc_tpu.cluster.retrypolicy import RetryPolicy
 from dmlc_tpu.cluster.rpc import TcpRpc, TcpRpcServer
 from dmlc_tpu.cluster.scrapetree import ScrapeDelegate, ScrapeTreeCoordinator
@@ -246,6 +248,35 @@ class ClusterNode:
             adopted = self.profiler.load(self.profile_path())
             if adopted:
                 self.flight.note("profile_warm_start", lanes=adopted)
+        # Root-cause plane (cluster/critpath.py, OBSERVABILITY §9): every
+        # node drains its sampled span DAGs into per-(model, stage, member)
+        # critical-path seconds; the snapshot rides obs.metrics to the
+        # leader, which folds the fleet table + runs the drift sentinel.
+        self.critpath = (
+            CritPathAnalyzer(
+                window_s=config.critpath_window_s,
+                windows=config.critpath_windows,
+                decay=config.critpath_decay,
+                clock=self.clock.monotonic,
+            )
+            if config.critpath_enabled else None
+        )
+        self.fleet_critpath = FleetCritPath()
+        self.sentinel = (
+            DriftSentinel(
+                quantile=config.sentinel_quantile,
+                drift_factor=config.sentinel_drift_factor,
+                clear_factor=config.sentinel_clear_factor,
+                min_samples=config.sentinel_min_samples,
+                confirm_windows=config.sentinel_confirm_windows,
+                baseline_decay=config.sentinel_baseline_decay,
+                force_sample_s=config.sentinel_force_sample_s,
+                flight_note=self.flight.note,
+                force_sample=self._drift_force_sample,
+                request_replan=self._drift_request_replan,
+            )
+            if config.sentinel_enabled and config.critpath_enabled else None
+        )
         # Worst clamp distance seen in the last merged fleet trace (set by
         # export_fleet_trace below); 0 until a trace has been collected.
         self._trace_max_skew = 0.0
@@ -369,7 +400,10 @@ class ClusterNode:
         )
         self.obs = ObsService(
             self.registry, flight=self.flight, lane=self.lane,
-            profiler=self.profiler,
+            profiler=self.profiler, critpath=self.critpath,
+            claim_unlaned=lambda: (
+                self.standby is not None and self.standby.is_leader
+            ),
         )
         # Scrape-tree delegate surface (cluster/scrapetree.py): ANY member
         # can scrape a ring span on the leader's behalf — delegates are
@@ -721,6 +755,9 @@ class ClusterNode:
                 # ``model@tenant`` profiler lane.
                 tenants=sorted(self.tenant_specs),
                 tenant_guard=self.tenant_guard,
+                # Root-cause attribution (OBSERVABILITY §9): every burn
+                # alert names the model's top critical-path contributor.
+                attribution=self.fleet_critpath.culprit,
             )
         # Survivable generation sessions (scheduler/genrouter.py, ISSUE 19):
         # the leader routes job.generate by the scraped per-member gauges
@@ -783,6 +820,15 @@ class ClusterNode:
                     "autoscaler": (
                         self.autoscaler.status()
                         if self.autoscaler is not None else {}
+                    ),
+                },
+                # Fleet critical-path table + drift sentinel state
+                # (cluster/critpath.py + sentinel.py, OBSERVABILITY §9).
+                "obs.critpath": lambda p: {
+                    "critpath": self.fleet_critpath.table(),
+                    "sentinel": (
+                        self.sentinel.status()
+                        if self.sentinel is not None else {}
                     ),
                 },
             }),
@@ -1195,6 +1241,15 @@ class ClusterNode:
             self.fleet_metrics = fleet
             for addr, reply in fleet.items():
                 self.profiler.ingest_scrape(addr, reply)
+                # Critical-path snapshots ride the same scrape reply
+                # (OBSERVABILITY §9): fold the fleet table the culprit
+                # attribution and the drift sentinel read from.
+                crit = reply.get("critpath")
+                if crit is not None:
+                    self.fleet_critpath.fold(addr, crit)
+            self.fleet_critpath.prune(addrs)
+            if self.sentinel is not None:
+                self.sentinel.tick(self.fleet_critpath.table())
             if self.slo is not None:
                 state = self.slo.evaluate()
                 if self.autoscaler is not None:
@@ -1231,6 +1286,23 @@ class ClusterNode:
     def _if_leading(self, fn):
         if self.standby is not None and self.standby.is_leader:
             fn()
+
+    # ---- drift sentinel hooks (cluster/sentinel.py) --------------------
+
+    def _drift_force_sample(self, seconds: float) -> None:
+        """Sentinel alert hook: open a forced trace-sampling window locally
+        and push it to every member (best-effort) — the drift window must
+        be densely traced, not a head-sampling lottery."""
+        tracing.tracer.force_sampling(seconds)
+        observe.force_fleet_sampling(
+            self.rpc, sorted(self.active_member_addrs()), seconds,
+            timeout=self.config.scrape_timeout_s,
+        )
+
+    def _drift_request_replan(self, reason: str) -> None:
+        """Sentinel localization hook: drift pinned to one member asks the
+        scheduler for a placement replan under that evidence."""
+        self.scheduler.request_replan(reason)
 
     def _genrouter_loop(self) -> None:
         """While leading: migrate generation sessions off dead, convicted,

@@ -37,6 +37,10 @@ from dmlc_tpu.utils.tracing import traced_methods, tracer
 
 log = logging.getLogger(__name__)
 
+# Synthetic span adopting a trace's orphans in the merged fleet timeline;
+# critpath.stage_of folds it into the GAP stage.
+from dmlc_tpu.cluster.critpath import ORPHAN_ROOT_NAME  # noqa: E402
+
 
 class ObsService:
     """One node's observability RPC surface (registered on the member
@@ -46,13 +50,20 @@ class ObsService:
     report their own timeline."""
 
     def __init__(self, registry: Registry, flight=None, lane: str | None = None,
-                 profiler=None):
+                 profiler=None, critpath=None, claim_unlaned=None):
         self.registry = registry
         self.flight = flight
         self.lane = lane
         # Live cost profiles (cluster/profile.py): the leader's instance
         # holds fleet-wide lanes; a member's holds its own (gen/step etc.).
         self.profiler = profiler
+        # Critical-path analyzer (cluster/critpath.py): drained from the
+        # process tracer on every scrape so the snapshot rides obs.metrics
+        # to the leader with zero extra RPCs. ``claim_unlaned`` is a
+        # callable — "am I the leader right now" — deciding whether this
+        # node charges traces whose root span carries no lane.
+        self.critpath = critpath
+        self.claim_unlaned = claim_unlaned
 
     def methods(self) -> dict:
         return traced_methods({
@@ -62,7 +73,16 @@ class ObsService:
             "obs.trace_ctl": self._trace_ctl,
             "obs.flight": self._flight,
             "obs.profile": self._profile,
+            "obs.critpath": self._critpath,
         })
+
+    def _critpath_snapshot(self) -> dict | None:
+        if self.critpath is None:
+            return None
+        claim = bool(self.claim_unlaned()) if self.claim_unlaned else False
+        self.critpath.ingest_tracer(tracer, own_lane=self.lane,
+                                    claim_unlaned=claim)
+        return self.critpath.snapshot()
 
     def _metrics(self, p: dict) -> dict:
         # ``mergeable`` (scrape-tree delegates set it) swaps the latency
@@ -70,11 +90,15 @@ class ObsService:
         # counter-exactly; the sampling block makes the adaptive trace
         # controller's behavior observable fleet-wide.
         mergeable = bool(p.get("mergeable"))
-        return {
+        out = {
             "metrics": self.registry.snapshot(mergeable=mergeable),
             "spans": tracer.summary(),
             "sampling": tracer.sampling_summary(),
         }
+        crit = self._critpath_snapshot()
+        if crit is not None:
+            out["critpath"] = crit
+        return out
 
     def _clock(self, p: dict) -> dict:
         # The tracer's own clock — the timebase every span timestamp lives
@@ -111,6 +135,10 @@ class ObsService:
         if self.profiler is None:
             return {"profiles": {}}
         return self.profiler.snapshot()
+
+    def _critpath(self, p: dict) -> dict:
+        crit = self._critpath_snapshot()
+        return {"critpath": crit if crit is not None else {"models": {}}}
 
 
 # ---------------------------------------------------------------------------
@@ -214,17 +242,60 @@ class FleetTraceMerger:
         self._unreachable[addr] = str(error)
 
     def finish(self) -> dict:
-        """Run the deferred child-before-parent clamp pass and emit the
+        """Run the deferred child-before-parent clamp pass, attach orphan
+        spans (parent dropped by the sampling budget, ring overflow, or a
+        dead member) under one synthetic per-trace root, and emit the
         trace-event document."""
         clamped = 0
+        orphan_traces: set[str] = set()
+        orphans = 0
         for idx, addr, parent, start in self._deferred:
             floor = self._span_start.get(parent)
-            if floor is not None and start < floor:
+            if floor is None:
+                # Orphan: its parent never made it into the merge.
+                orphans += 1
+                trace = self._events[idx]["args"].get("trace")
+                if trace:
+                    orphan_traces.add(trace)
+                continue
+            if start < floor:
                 node = self._nodes[addr]
                 node["max_skew_s"] = max(node["max_skew_s"], floor - start)
                 node["clamped"] += 1
                 self._events[idx]["ts"] = floor * 1e6
                 clamped += 1
+        # Every trace holding an orphan gets ONE synthetic root spanning
+        # the trace's hull, adopting ALL its top-level spans (orphans AND
+        # true roots): downstream consumers — Perfetto nesting, critpath
+        # extraction — see one rooted tree, and overlap between the orphan
+        # subtree and the covered chain stays concurrent (never charged
+        # twice, shares never exceed 1.0).
+        if orphan_traces:
+            by_trace: dict[str, list[int]] = {}
+            for i, e in enumerate(self._events):
+                trace = e["args"].get("trace")
+                if trace in orphan_traces:
+                    by_trace.setdefault(trace, []).append(i)
+            for trace, idxs in sorted(by_trace.items()):
+                lo = min(self._events[i]["ts"] for i in idxs)
+                hi = max(self._events[i]["ts"] + self._events[i]["dur"]
+                         for i in idxs)
+                root_span = f"(orphan-root:{trace})"
+                for i in idxs:
+                    parent = self._events[i]["args"].get("parent")
+                    if parent is None or parent not in self._span_start:
+                        self._events[i]["args"]["parent"] = root_span
+                self._events.append({
+                    "name": ORPHAN_ROOT_NAME,
+                    "ph": "X",
+                    "ts": lo,
+                    "dur": hi - lo,
+                    "pid": self._events[idxs[0]]["pid"],
+                    "tid": 0,
+                    "args": {"trace": trace, "span": root_span,
+                             "synthetic": True},
+                })
+                self._span_start[root_span] = lo / 1e6
         other: dict = {
             "nodes": {
                 a: {"offset_s": info["offset_s"], "rtt_s": info["rtt_s"],
@@ -233,6 +304,8 @@ class FleetTraceMerger:
             },
             "skew_clamped_children": clamped,
         }
+        if orphans:
+            other["orphan_spans"] = orphans
         if self.skew_alert_s > 0 and self.flight is not None:
             for addr in sorted(self._nodes):
                 info = self._nodes[addr]
@@ -422,6 +495,7 @@ def render_fleet_prometheus(fleet: dict[str, dict], prefix: str = "dmlc") -> str
 
 __all__ = [
     "FleetTraceMerger",
+    "ORPHAN_ROOT_NAME",
     "ObsService",
     "collect_fleet_trace",
     "export_fleet_trace",

@@ -42,8 +42,10 @@ from dataclasses import dataclass, field
 from typing import Any, Iterator
 
 from dmlc_tpu.cluster import observe, tenant as tenant_mod, tracectx
+from dmlc_tpu.cluster.critpath import CritPathAnalyzer, FleetCritPath
 from dmlc_tpu.cluster.flight import FlightRecorder
 from dmlc_tpu.cluster.profile import CostProfiler
+from dmlc_tpu.cluster.sentinel import DriftSentinel
 from dmlc_tpu.cluster.rpc import (
     DeadlineExceeded,
     Overloaded,
@@ -235,6 +237,10 @@ class SimMember:
     PRESSURE_GAIN = 3.0   # service inflation at full admission pressure
     EVICT_PRESSURE = 0.5   # generate evictions start above this utilization
     EVICT_P = 0.25         # ... with this probability
+    # Per-stage decomposition of one simulated service: the critpath plane
+    # attributes request time to (stage, member), so the sim reports where
+    # its pretend time went. Fractions sum to 1.
+    STAGE_FRACTIONS = (("decode", 0.35), ("compute", 0.65))
 
     def __init__(self, net: SimRpcNetwork, addr: str, index: int, *,
                  seed: int, capacity_qps: float, scrape_timeout_s: float,
@@ -264,12 +270,23 @@ class SimMember:
         # trigger). The quota ordering makes this structurally zero; the
         # counter exists so the certificate PROVES it rather than assumes.
         self.cross_tenant_evictions = 0
+        # Injected per-stage slowdown ({stage: factor}) — the drift
+        # scenario's fault: ONE member's decode turning 5x mid-replay.
+        self.stage_slowdown: dict[str, float] = {}
         self.obs = observe.ObsService(self.registry, lane=addr)
         self.delegate = ScrapeDelegate(
             net.client(addr), timeout_s=scrape_timeout_s, concurrency=1,
             metrics=self.registry.counters,
         )
         net.serve(addr, self.methods())
+
+    def set_stage_slowdown(self, stage: str, factor: float) -> None:
+        """Inject (or clear, factor=1) a service-stage slowdown — the
+        drift sentinel certification's mid-replay fault."""
+        if factor == 1.0:
+            self.stage_slowdown.pop(stage, None)
+        else:
+            self.stage_slowdown[stage] = float(factor)
 
     def set_capacity(self, capacity_qps: float) -> None:
         """Autoscaler actuation in the sim: a capacity change models
@@ -355,6 +372,17 @@ class SimMember:
         # degrades (and burns ITS SLO lane) while within-quota tenants
         # keep their service times.
         service *= 1.0 + self.PRESSURE_GAIN * pressure
+        # Per-stage breakdown + injected slowdowns. The no-fault path adds
+        # exactly 0.0, keeping legacy seeded latencies bit-identical; a
+        # slowed stage stretches the total by its share * (factor - 1).
+        stages = {
+            stage: service * frac * self.stage_slowdown.get(stage, 1.0)
+            for stage, frac in self.STAGE_FRACTIONS
+        }
+        service += sum(
+            service * frac * (self.stage_slowdown.get(stage, 1.0) - 1.0)
+            for stage, frac in self.STAGE_FRACTIONS
+        )
         if (
             kind == "generate"
             and pressure > self.EVICT_PRESSURE
@@ -382,7 +410,7 @@ class SimMember:
                 f"exceeds {budget:.3f}s budget"
             )
         self.registry.latency(f"rpc/job.{kind}").record(service)
-        return {"service_s": service}
+        return {"service_s": service, "stages": stages}
 
 
 # ---------------------------------------------------------------------------
@@ -436,6 +464,10 @@ class ReplayHarness:
         autoscale_max_units: int = 8,
         autoscale_clear_windows: int = 3,
         autoscale_moves_budget: int = 2,
+        drift: dict[str, Any] | None = None,
+        sentinel_min_samples: int = 20,
+        sentinel_confirm_windows: int = 3,
+        sentinel_drift_factor: float = 2.0,
     ):
         if n_members < 2:
             raise ValueError("certification needs at least 2 members")
@@ -473,6 +505,36 @@ class ReplayHarness:
         self.profiler = CostProfiler(
             window_s=5.0, windows=64, clock=self.net.clock, seed=spec.seed
         )
+        # Root-cause plane under certification (OBSERVABILITY §9): every
+        # served request's synthesized span DAG is charged into the REAL
+        # critpath analyzer on the virtual clock; the fleet fold feeds burn
+        # attribution and the REAL drift sentinel, exactly as on a leader.
+        self.replan_requests: list[str] = []
+        self.flight = FlightRecorder(clock=self.net.clock, node="loadgen")
+        self.critpath = CritPathAnalyzer(
+            window_s=float(scrape_interval_s), windows=16,
+            clock=self.net.clock, seed=spec.seed,
+        )
+        self.fleet_critpath = FleetCritPath()
+        self.sentinel = DriftSentinel(
+            drift_factor=float(sentinel_drift_factor),
+            min_samples=int(sentinel_min_samples),
+            confirm_windows=int(sentinel_confirm_windows),
+            force_sample_s=float(burn_force_sample_s) or 15.0,
+            flight_note=self.flight.note,
+            force_sample=self._drift_force_sample,
+            request_replan=self.replan_requests.append,
+        )
+        # The injected fault: {"member": index, "stage": name, "factor": x,
+        # "at_fraction": when} — ONE member's stage slows mid-replay, and
+        # the certificate must show the sentinel naming it.
+        self.drift = dict(drift) if drift else None
+        self._drift_applied = False
+        self._drift_injected_cycle: int | None = None
+        self._drift_alert_cycle: int | None = None
+        self.drift_alerts: list[dict[str, Any]] = []
+        self.drift_force_windows = 0
+        self._trace_seq = 0
         if objectives is None:
             objectives = self.default_objectives(spec)
         self.objectives = objectives
@@ -493,6 +555,10 @@ class ReplayHarness:
             # model objective on that tenant's traffic only.
             tenants=[t for t in spec.tenants()
                      if t != tenant_mod.DEFAULT_TENANT],
+            flight=self.flight,
+            # Burn alerts name their critical-path culprit — the field the
+            # certificate's critpath gate requires on every burn event.
+            attribution=self.fleet_critpath.culprit,
         )
         self._dispatch_rng = random.Random(spec.seed ^ 0xD15)
         self.tallies: dict[str, ModelTally] = {}
@@ -510,14 +576,12 @@ class ReplayHarness:
         # replica's worth of serving). The certificate pins convergence:
         # scale-up within the fast-burn windows, scale-down after quiet.
         self.autoscaler: Autoscaler | None = None
-        self.flight: FlightRecorder | None = None
         self._capacity_units = 1
         self._first_burn_cycle: int | None = None
         self._first_up_cycle: int | None = None
         self._first_down_cycle: int | None = None
         self._breach_after_down = False
         if autoscale:
-            self.flight = FlightRecorder(clock=self.net.clock, node="loadgen")
             self.autoscaler = Autoscaler(
                 flight=self.flight,
                 metrics=self.leader_registry.counters,
@@ -538,6 +602,16 @@ class ReplayHarness:
         for member in self.members:
             member.set_capacity(self.per_member_qps * self._capacity_units)
         return self._capacity_units
+
+    def _drift_force_sample(self, seconds: float) -> None:
+        """Sentinel actuation: a confirmed drift opens a forced-sampling
+        window fleet-wide — the same hook a burning SLO uses — so the
+        traces that explain the shift are captured while it is happening."""
+        tracing.tracer.force_sampling(seconds)
+        observe.force_fleet_sampling(
+            self.client, self.member_addrs, seconds, timeout=1.0,
+        )
+        self.drift_force_windows += 1
 
     @staticmethod
     def default_objectives(spec: TrafficSpec) -> dict[str, SloObjective]:
@@ -603,6 +677,16 @@ class ReplayHarness:
         self.redelegations_total += result.redelegations
         for addr, reply in result.members.items():
             self.profiler.ingest_scrape(addr, reply)
+        # Root-cause fold BEFORE the SLO evaluation: the analyzer snapshot
+        # lands in the fleet fold, the sentinel judges the folded table,
+        # and only then does the evaluator run — so a burn alert fired
+        # this cycle carries the freshest culprit attribution.
+        self.fleet_critpath.fold("sim", self.critpath.snapshot())
+        fired = self.sentinel.tick(self.fleet_critpath.table())
+        if fired:
+            self.drift_alerts.extend(fired)
+            if self._drift_alert_cycle is None:
+                self._drift_alert_cycle = self.scrape_cycles
         state = self.slo.evaluate()
         burning = self.slo.burning_models()
         if self.autoscaler is not None:
@@ -655,7 +739,58 @@ class ReplayHarness:
         if lane != mix.model:
             self.profiler.record(lane, member, "dispatch", latency)
 
+    def _inject_drift_if_due(self) -> None:
+        """Apply the configured mid-replay stage fault once its time
+        arrives: ONE member's stage slows by the configured factor, and
+        from here on the certificate's detection timeline is live."""
+        if self.drift is None or self._drift_applied:
+            return
+        if self.net.now < float(self.drift.get("at_fraction", 0.5)) \
+                * self.spec.duration_s:
+            return
+        idx = int(self.drift.get("member", 0)) % len(self.members)
+        stage = str(self.drift.get("stage", "decode"))
+        factor = float(self.drift.get("factor", 5.0))
+        self.members[idx].set_stage_slowdown(stage, factor)
+        self._drift_applied = True
+        self._drift_injected_cycle = self.scrape_cycles
+        self.flight.note(
+            "drift_injected", member=self.member_addrs[idx],
+            stage=stage, factor=factor,
+        )
+
+    def _emit_trace(self, mix: TrafficMix, member: str, latency: float,
+                    stages: dict[str, Any]) -> None:
+        """Synthesize the served request's span DAG — the same tree the
+        real dispatch path traces (root -> dispatch -> rpc -> host/decode
+        then device/forward) — and charge it into the critpath analyzer,
+        so burn attribution and the drift sentinel run on the real
+        extraction math, not on the sim's own stage numbers."""
+        self._trace_seq += 1
+        trace = f"sim{self._trace_seq}"
+        sid = f"{trace}-"
+        t0 = self.net.now
+        decode_s = max(0.0, float(stages.get("decode", 0.0)))
+        compute_s = max(0.0, float(stages.get("compute", 0.0)))
+        self.critpath.ingest([
+            {"name": "loadgen/request", "trace": trace, "span": sid + "root",
+             "start": t0, "dur": latency, "attrs": {"model": mix.model}},
+            {"name": "scheduler/dispatch", "trace": trace, "span": sid + "d",
+             "parent": sid + "root", "start": t0, "dur": latency,
+             "lane": self.leader_addr},
+            {"name": f"rpc/job.{mix.kind}", "trace": trace, "span": sid + "r",
+             "parent": sid + "d", "start": t0, "dur": latency,
+             "lane": member},
+            {"name": "host/decode", "trace": trace, "span": sid + "dec",
+             "parent": sid + "r", "start": t0, "dur": decode_s,
+             "lane": member},
+            {"name": "device/forward", "trace": trace, "span": sid + "f",
+             "parent": sid + "r", "start": t0 + decode_s, "dur": compute_s,
+             "lane": member},
+        ])
+
     def _dispatch(self, mix: TrafficMix) -> None:
+        self._inject_drift_if_due()
         member = self.member_addrs[
             self._dispatch_rng.randrange(len(self.member_addrs))
         ]
@@ -708,6 +843,9 @@ class ReplayHarness:
         tally.latencies.append(latency)
         tenant_tally.latencies.append(latency)
         self._record_latency(mix, member, latency)
+        stages = reply.get("stages")
+        if isinstance(stages, dict):
+            self._emit_trace(mix, member, latency, stages)
 
     # ---- certificate ---------------------------------------------------
 
@@ -766,6 +904,7 @@ class ReplayHarness:
         autoscaler_doc = self._autoscaler_section()
         if autoscaler_doc is not None:
             extra["autoscaler"] = autoscaler_doc
+        extra["critpath"] = self._critpath_section()
         return self._jsonsafe({
             "version": SLO_CERT_VERSION,
             "seed": self.spec.seed,
@@ -875,6 +1014,46 @@ class ReplayHarness:
             ),
             "tenants": tenants,
         }
+
+    def _critpath_section(self) -> dict:
+        """Root-cause evidence: the folded critical-path table the culprit
+        attribution reads, the sentinel's lane states, every burn and
+        drift flight event, and — when a drift fault was injected — the
+        detection timeline the certification pins (injection cycle, alert
+        cycle, the alerts themselves, forced-sampling windows, replan
+        requests)."""
+        flight = self.flight.to_wire()
+        burn_events = [e for e in flight["events"]
+                       if e.get("kind") in ("slo_fast_burn", "slo_slow_burn")]
+        drift_events = [
+            e for e in flight["events"]
+            if str(e.get("kind", "")).startswith(("latency_drift", "drift_"))
+        ]
+        out: dict[str, Any] = {
+            "table": self.fleet_critpath.table(),
+            "sentinel": self.sentinel.status(),
+            "burn_events": burn_events,
+            "drift_events": drift_events,
+        }
+        if self.drift is not None:
+            cycles = None
+            if self._drift_alert_cycle is not None \
+                    and self._drift_injected_cycle is not None:
+                cycles = self._drift_alert_cycle - self._drift_injected_cycle
+            out["drift"] = {
+                "spec": dict(self.drift),
+                "injected_member": self.member_addrs[
+                    int(self.drift.get("member", 0)) % len(self.members)
+                ],
+                "injected": self._drift_applied,
+                "injected_cycle": self._drift_injected_cycle,
+                "alert_cycle": self._drift_alert_cycle,
+                "cycles_to_alert": cycles,
+                "alerts": list(self.drift_alerts),
+                "force_windows": self.drift_force_windows,
+                "replan_requests": list(self.replan_requests),
+            }
+        return out
 
     def _autoscaler_section(self) -> dict | None:
         """Convergence evidence for the elastic loop: when the first burn
@@ -997,6 +1176,7 @@ def validate_slo_cert(doc: dict) -> list[str]:
     problems.extend(_validate_tenants(doc, models))
     problems.extend(_validate_autoscaler(doc))
     problems.extend(validate_sessions(doc))
+    problems.extend(_validate_critpath(doc))
     return problems
 
 
@@ -1056,6 +1236,82 @@ def _validate_tenants(doc: dict, models: dict) -> list[str]:
             f"tenants request total {tenant_requests} != "
             f"models request total {model_requests}"
         )
+    return problems
+
+
+def _validate_critpath(doc: dict) -> list[str]:
+    """The root-cause section's invariants (optional section — absent on
+    pre-critpath certificates): every charged model's lane shares must sum
+    to 1 (never more), every burn alert for a model the table attributes
+    must carry its named culprit, and a run that injected a drift fault
+    must show the sentinel detecting it — the right (model, stage, member)
+    named, the forced-sampling window opened, the replan requested."""
+    body = doc.get("critpath")
+    if body is None:
+        return []
+    problems: list[str] = []
+    if not isinstance(body, dict) or not isinstance(body.get("table"), dict):
+        return ["critpath section is not an object with a table"]
+    models = body["table"].get("models")
+    if not isinstance(models, dict):
+        return ["critpath.table.models missing"]
+    for model, mbody in models.items():
+        lanes = (mbody or {}).get("lanes")
+        if not isinstance(lanes, list) or not lanes:
+            problems.append(f"critpath.{model}: no lanes")
+            continue
+        total = 0.0
+        for ln in lanes:
+            share = float((ln or {}).get("share") or 0.0)
+            if share < 0.0 or share > 1.0 + 1e-9:
+                problems.append(f"critpath.{model}: share {share} out of range")
+            total += share
+        if total > 1.0 + 1e-6 or abs(total - 1.0) > 1e-6:
+            problems.append(f"critpath.{model}: shares sum {total:.8f} != 1")
+    burns = body.get("burn_events")
+    if not isinstance(burns, list):
+        problems.append("critpath.burn_events missing")
+        burns = []
+    for i, ev in enumerate(burns):
+        if not isinstance(ev, dict):
+            problems.append(f"critpath.burn_events[{i}] not an object")
+            continue
+        if str(ev.get("model") or "") not in models:
+            continue  # the table never attributed this lane; nothing owed
+        if "culprit_stage" not in ev or "culprit_member" not in ev \
+                or "critpath_share" not in ev:
+            problems.append(f"critpath.burn_events[{i}] lacks culprit")
+    drift = body.get("drift")
+    if drift is None:
+        return problems
+    if not isinstance(drift, dict):
+        return [*problems, "critpath.drift is not an object"]
+    if not drift.get("injected"):
+        problems.append("critpath.drift: fault was never injected")
+        return problems
+    spec = drift.get("spec") or {}
+    member = str(drift.get("injected_member") or "")
+    stage = str(spec.get("stage") or "decode")
+    alerts = drift.get("alerts")
+    if not isinstance(alerts, list) or not alerts:
+        problems.append("critpath.drift: sentinel never alerted")
+        return problems
+    first = alerts[0] if isinstance(alerts[0], dict) else {}
+    if str(first.get("member")) != member or str(first.get("stage")) != stage:
+        problems.append(
+            "critpath.drift: first alert names "
+            f"({first.get('stage')}, {first.get('member')}), "
+            f"fault was ({stage}, {member})"
+        )
+    if not isinstance(drift.get("cycles_to_alert"), int):
+        problems.append("critpath.drift: cycles_to_alert missing")
+    if int(drift.get("force_windows") or 0) < 1:
+        problems.append("critpath.drift: no forced-sampling window opened")
+    replans = drift.get("replan_requests")
+    if not isinstance(replans, list) or not replans:
+        problems.append("critpath.drift: no replan requested")
+    elif not any(member in str(r) and stage in str(r) for r in replans):
+        problems.append("critpath.drift: replan reason names no culprit")
     return problems
 
 
@@ -1157,6 +1413,69 @@ def tenant_isolation_harness(
     )
     params.update(overrides)
     return ReplayHarness(n_members, two_tenant_flash_spec(seed), **params)
+
+
+# ---------------------------------------------------------------------------
+# The canonical drift-sentinel scenario
+# ---------------------------------------------------------------------------
+#
+# One definition, three consumers: tests/test_critpath.py pins its
+# verdicts across the chaos-seed matrix, tools/slo_cert.py --critpath
+# replays it standalone, and tools/ci_check.sh runs that per seed leg.
+# A steady single-model predict load rides four members (none of them a
+# SLOW_EVERY straggler); at half-replay EXACTLY ONE member's decode stage
+# slows 5x. The certificate must show the sentinel naming (model, decode,
+# that member) within three detection windows of the injection, the next
+# fast-burn alert carrying the same culprit, a forced-sampling window
+# opening, and a placement replan requested with the culprit in its
+# reason — all read back from the flight recorder.
+
+DRIFT_MEMBER_INDEX = 1
+DRIFT_STAGE = "decode"
+DRIFT_FACTOR = 5.0
+DRIFT_SCRAPE_INTERVAL_S = 2.5
+DRIFT_FAST_WINDOW_S = 5.0
+# Detection bound the certification pins: the sentinel must name the
+# culprit within this many fast-burn windows of the injection.
+DRIFT_DETECT_FAST_WINDOWS = 3
+
+
+def drift_soak_spec(
+    seed: int, *, base_rps: float = 40.0, duration_s: float = 240.0,
+) -> TrafficSpec:
+    """The pinned drift traffic shape: one steady predict mix, no flash
+    crowds — the injected stage fault is the ONLY latency shift in the
+    run, so any alert the sentinel raises is attributable to it."""
+    return TrafficSpec(
+        mixes=(TrafficMix("resnet50", "predict", 1.0),),
+        base_rps=base_rps,
+        duration_s=duration_s,
+        seed=seed,
+    )
+
+
+def drift_sentinel_harness(
+    n_members: int, seed: int, **overrides: Any
+) -> ReplayHarness:
+    """ReplayHarness wired for the drift certification: scrape cadence ==
+    analyzer window (every fold carries one fresh window of samples), a
+    short fast-burn window with a threshold the one-member slowdown
+    clearly crosses (frac-over ~0.11 of a 0.05 budget => burn ~2.3), and
+    the 5x decode fault on one member at half-replay."""
+    params: dict[str, Any] = dict(
+        scrape_interval_s=DRIFT_SCRAPE_INTERVAL_S,
+        fast_window_s=DRIFT_FAST_WINDOW_S,
+        fast_burn=1.5,
+        drift={
+            "member": DRIFT_MEMBER_INDEX, "stage": DRIFT_STAGE,
+            "factor": DRIFT_FACTOR, "at_fraction": 0.5,
+        },
+        sentinel_min_samples=20,
+        sentinel_confirm_windows=3,
+        sentinel_drift_factor=2.0,
+    )
+    params.update(overrides)
+    return ReplayHarness(n_members, drift_soak_spec(seed), **params)
 
 
 # ---------------------------------------------------------------------------

@@ -116,9 +116,14 @@ class SloEvaluator:
         on_fast_burn: Callable[[str], None] | None = None,
         tenants: list[str] | None = None,
         tenant_guard: Any = None,
+        attribution: Callable[[str], dict[str, Any] | None] | None = None,
     ) -> None:
         self.profiler = profiler
         self.objectives = dict(objectives)
+        # Root-cause hook (cluster/critpath.FleetCritPath.culprit): maps a
+        # model to its top critical-path contributor so every burn alert
+        # names (stage, member, critpath_share) instead of just the model.
+        self.attribution = attribution
         self.fast_window_s = float(fast_window_s)
         self.slow_window_s = float(slow_window_s)
         self.fast_burn = float(fast_burn)
@@ -166,6 +171,25 @@ class SloEvaluator:
             tenant = self.tenant_guard.label(tenant)
         return f"{model}@{tenant}"
 
+    def _culprit(self, model: str) -> dict[str, Any]:
+        """Flight-note fields naming the model's top critical-path
+        contributor; empty when attribution is unwired or has no data yet
+        (a burn note without a culprit beats no burn note)."""
+        if self.attribution is None:
+            return {}
+        try:
+            top = self.attribution(model)
+        except Exception:  # the alert must land even if attribution dies
+            log.exception("slo attribution failed for %s", model)
+            return {}
+        if not top:
+            return {}
+        return {
+            "culprit_stage": str(top.get("stage", "")),
+            "culprit_member": str(top.get("member", "")),
+            "critpath_share": float(top.get("critpath_share", 0.0)),
+        }
+
     def _burn(self, obj: SloObjective, horizon_s: float,
               lane: str | None = None) -> float:
         frac = self.profiler.frac_over(
@@ -197,11 +221,13 @@ class SloEvaluator:
                             if self.metrics is not None:
                                 self.metrics.inc(f"slo_{win}_burn_alerts")
                             if self.flight is not None:
+                                culprit = self._culprit(model)
                                 self.flight.note(
                                     f"slo_{win}_burn", model=model,
                                     burn=round(st[win], 3), threshold=threshold,
                                     objective_s=obj.latency_s,
                                     **({"tenant": tenant} if tenant else {}),
+                                    **culprit,
                                 )
                             log.warning("SLO %s burn for %s: %.1fx budget "
                                         "(threshold %.1fx)", win, lane,
@@ -248,6 +274,12 @@ class SloEvaluator:
                 "fast_alert": st.get("fast_alert", False),
                 "slow_alert": st.get("slow_alert", False),
             }
+            if self.attribution is not None:
+                try:
+                    body["culprit"] = self.attribution(model)
+                except Exception:
+                    log.exception("slo attribution failed for %s", model)
+                    body["culprit"] = None
             if self.tenants:
                 body["tenants"] = {
                     t: {

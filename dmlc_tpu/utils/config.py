@@ -218,6 +218,33 @@ class ClusterConfig:
     # traces, not a 1% lottery.
     trace_burn_force_sample_s: float = 0.0
 
+    # --- root-cause plane (cluster/critpath.py + sentinel.py, §9) -------
+    # Per-request critical-path attribution: every node drains its sampled
+    # span DAGs into per-(model, stage, member) critical-path seconds on
+    # the scrape cadence; the leader folds the fleet table, names burn
+    # culprits, and feeds the drift sentinel.
+    critpath_enabled: bool = True
+    # Rolling aggregation: windows of critpath_window_s seconds, the last
+    # critpath_windows kept, older windows decayed by critpath_decay**age.
+    critpath_window_s: float = 30.0
+    critpath_windows: int = 16
+    critpath_decay: float = 0.7
+    # Latency drift sentinel (leader-side, scrape cadence): alert when a
+    # lane's recent qNN self-time exceeds drift_factor x its decay-learned
+    # baseline for confirm_windows consecutive ticks (clears below
+    # clear_factor after the same streak); lanes with fewer than
+    # min_samples recent requests are never judged.
+    sentinel_enabled: bool = True
+    sentinel_quantile: float = 90.0
+    sentinel_drift_factor: float = 2.0
+    sentinel_clear_factor: float = 1.3
+    sentinel_min_samples: int = 20
+    sentinel_confirm_windows: int = 3
+    sentinel_baseline_decay: float = 0.8
+    # On a drift alert, force-sample every trace fleet-wide this long
+    # (seconds; 0 disables) so the drift window is densely traced.
+    sentinel_force_sample_s: float = 30.0
+
     # --- device-plane telemetry (cluster/devicemon.py, OBSERVABILITY §8) ---
     # HBM watermark/alert poll cadence (0 disables the poll loop; gauges
     # still read live on every scrape).

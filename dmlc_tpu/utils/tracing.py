@@ -106,6 +106,7 @@ class Tracer:
         self.max_events = max_events
         self._events: list[SpanRecord] = []
         self._dropped = 0
+        self.resets = 0  # bumped by reset(); cursor-based drains re-seek
         self._aggregates: dict[str, LatencyStats] = {}
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
@@ -296,13 +297,22 @@ class Tracer:
                 out["dropped_events"] = self._dropped
             return out
 
-    def events_wire(self, lane: str | None = None) -> list[dict]:
+    @property
+    def event_count(self) -> int:
+        """Raw spans currently buffered — with ``resets``, the cursor
+        contract for incremental drains (``events_wire(offset=...)``)."""
+        with self._lock:
+            return len(self._events)
+
+    def events_wire(self, lane: str | None = None, offset: int = 0) -> list[dict]:
         """Raw spans in wire form for ``obs.trace_dump``. With ``lane``
         given, only spans executed under that lane (plus unlaned spans —
         in production one process is one node, so ambient work with no
-        serving scope still belongs to it)."""
+        serving scope still belongs to it). ``offset`` skips already-seen
+        spans (the buffer is append-only between resets, so an index plus
+        the ``resets`` counter is a stable drain cursor)."""
         with self._lock:
-            events = list(self._events)
+            events = self._events[offset:] if offset > 0 else list(self._events)
         out = []
         for e in events:
             if lane is not None and e.lane is not None and e.lane != lane:
@@ -366,6 +376,7 @@ class Tracer:
             self._events.clear()
             self._aggregates.clear()
             self._dropped = 0
+            self.resets += 1
             self._t0 = time.perf_counter()
             self._sampled_roots = 0
             self._unsampled_roots = 0

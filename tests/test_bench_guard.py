@@ -707,6 +707,84 @@ class TestShardedGuard:
         assert "degraded_vs_history" not in out["lm_wide_gang"]
 
 
+class TestCritpathGuard:
+    """ISSUE 20: the e2e leg's critical-path breakdown is guarded like the
+    device section — malformed share sums are flagged instead of trusted,
+    a bottleneck handoff vs the committed artifact is stamped machine-
+    visibly, and the merge keeps a previous capture stamped stale when a
+    run produced none (the section is one coherent attribution of a single
+    leg, so a fresh capture replaces it wholesale)."""
+
+    OLD = {
+        "models": {
+            "resnet50": {
+                "requests": 128, "total_s": 4.2, "max_lanes": 2,
+                "lanes": [
+                    {"stage": "decode", "member": "host", "crit_s": 2.9,
+                     "share": 0.690476},
+                    {"stage": "compute", "member": "tpu0", "crit_s": 1.3,
+                     "share": 0.309524},
+                ],
+                "top_lane": "decode@host",
+            }
+        }
+    }
+
+    def test_healthy_section_stamps_top_lane_only(self):
+        out = bench.annotate_critpath_entries(
+            json.loads(json.dumps(self.OLD)), self.OLD)
+        body = out["models"]["resnet50"]
+        assert body["top_lane"] == "decode@host"
+        assert "malformed" not in body and "malformed" not in out
+        assert "bottleneck_shifted" not in body
+
+    def test_share_sum_off_by_more_than_rounding_is_malformed(self):
+        broken = {"models": {"resnet50": {
+            "requests": 1, "total_s": 1.0, "max_lanes": 1,
+            "lanes": [{"stage": "decode", "member": "host",
+                       "crit_s": 0.5, "share": 0.5}],
+        }}}
+        out = bench.annotate_critpath_entries(broken, None)
+        assert out["models"]["resnet50"]["malformed"] is True
+        assert out["malformed"] is True
+
+    def test_bottleneck_handoff_stamped_vs_previous_artifact(self):
+        fresh = json.loads(json.dumps(self.OLD))
+        fresh["models"]["resnet50"]["lanes"].reverse()  # compute now dominates
+        del fresh["models"]["resnet50"]["top_lane"]
+        out = bench.annotate_critpath_entries(fresh, self.OLD)
+        body = out["models"]["resnet50"]
+        assert body["top_lane"] == "compute@tpu0"
+        assert body["prev_top_lane"] == "decode@host"
+        assert body["bottleneck_shifted"] is True
+
+    def test_none_and_no_history_pass_through(self):
+        assert bench.annotate_critpath_entries(None, self.OLD) is None
+        out = bench.annotate_critpath_entries(
+            json.loads(json.dumps(self.OLD)), None)
+        assert "bottleneck_shifted" not in out["models"]["resnet50"]
+
+    def test_merge_replaces_wholesale_or_keeps_stale(self):
+        fresh = {"models": {"resnet50": {
+            "requests": 2, "total_s": 1.0, "max_lanes": 1,
+            "lanes": [{"stage": "compute", "member": "tpu0",
+                       "crit_s": 1.0, "share": 1.0}],
+        }}}
+        out = bench.merge_detail(
+            {"configs": [], "critpath": fresh},
+            {"configs": [], "critpath": self.OLD})
+        assert out["critpath"]["models"]["resnet50"]["requests"] == 2
+        assert "stale" not in out["critpath"]
+        out2 = bench.merge_detail(
+            {"configs": [], "critpath": None},
+            {"configs": [], "critpath": self.OLD})
+        assert out2["critpath"]["stale"] is True
+        assert out2["critpath"]["models"]["resnet50"]["requests"] == 128
+        # No capture on either side: no section invented.
+        assert "critpath" not in bench.merge_detail({"configs": []},
+                                                    {"configs": []})
+
+
 class TestDeviceLegs:
     """bench.py's per-leg device-plane capture (ISSUE 15): census deltas
     bracketed around each leg, assembled into bench_detail.json["device"]."""

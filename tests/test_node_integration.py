@@ -244,3 +244,44 @@ def test_leader_failover_resumes_jobs(cluster3, tmp_path):
     out = cli.run_command("jobs")
     assert "40/40 finished" in out
     assert "accuracy 100.00%" in out
+
+
+def test_critpath_verb_renders_fleet_attribution(cluster3):
+    """The CLI `critpath` verb surfaces the leader's folded critical-path
+    table (docs/OBSERVABILITY.md section 9): after traced predict traffic,
+    (stage x member) lanes render with charged seconds and shares, and the
+    `slo` verb grows the culprit column alongside its burn columns."""
+    from dmlc_tpu.utils.tracing import tracer
+
+    nodes = cluster3
+    leader = nodes[0]
+    cli = Cli(nodes[1])
+    try:
+        tracer.enabled = True
+        cli.run_command("predict")
+        wait_until(
+            lambda: all(j.done for j in leader.scheduler.jobs.values()),
+            msg="jobs complete",
+        )
+        # Charge the process tracer's spans and fold them leader-side the
+        # same way the scrape cycle does — without waiting for its cadence.
+        assert leader.critpath is not None
+        leader.critpath.ingest_tracer(tracer, own_lane=None)
+        leader.fleet_critpath.fold("local", leader.critpath.snapshot())
+
+        out = cli.run_command("critpath")
+        lines = out.splitlines()
+        assert "model" in lines[0] and "share" in lines[0], out
+        assert len(lines) >= 2, out
+        # --top bounds lanes per model; unknown models and extra args are
+        # clean misses, not crashes.
+        top = cli.run_command("critpath --top 1")
+        assert len(top.splitlines()) <= len(lines)
+        assert "no critical-path lanes" in cli.run_command("critpath nope")
+        assert "usage:" in cli.run_command("critpath a b")
+        # The slo verb still renders (culprit column rides along when
+        # objectives exist; this fleet declares none).
+        assert cli.run_command("slo")
+    finally:
+        tracer.enabled = False
+        tracer.reset()

@@ -581,3 +581,71 @@ class TestFleetTraceMergerStreaming:
                     if e.get("ph") == "X" and e["name"] == "device/forward"]
         assert rendered[0]["ts"] == pytest.approx(1.0 * 1e6)
         assert doc["otherData"]["skew_clamped_children"] == 1
+
+    def test_orphan_spans_adopted_under_synthetic_root(self):
+        # A span whose parent was dropped (sampling budget, ring overflow,
+        # dead member) must NOT vanish from the merged document or dangle
+        # with a broken parent edge: its trace gets ONE synthetic root
+        # spanning the hull, adopting the orphan AND the trace's true
+        # top-level spans, and the degradation is counted in
+        # otherData.orphan_spans (docs/OBSERVABILITY.md section 9).
+        from dmlc_tpu.cluster.critpath import ORPHAN_ROOT_NAME
+        from dmlc_tpu.cluster.observe import merge_fleet_trace
+
+        orphan = {"name": "gen/step", "start": 1.2, "dur": 0.1,
+                  "span": "s9", "parent": "never-arrived", "trace": "t1"}
+        doc = merge_fleet_trace({
+            "a": self._node([self.PARENT]),
+            "b": self._node([orphan]),
+        })
+        assert doc["otherData"]["orphan_spans"] == 1
+        roots = [e for e in doc["traceEvents"]
+                 if e.get("name") == ORPHAN_ROOT_NAME]
+        assert len(roots) == 1
+        root = roots[0]
+        assert root["args"]["trace"] == "t1"
+        assert root["args"]["synthetic"] is True
+        # The root spans the trace hull (PARENT [1.0, 1.5] + orphan
+        # [1.2, 1.3], in microseconds).
+        assert root["ts"] == pytest.approx(1.0 * 1e6)
+        assert root["dur"] == pytest.approx(0.5 * 1e6)
+        # BOTH the orphan and the true top-level span hang off it, so
+        # downstream consumers (Perfetto nesting, critpath extraction) see
+        # one rooted tree per trace.
+        by_span = {e["args"].get("span"): e for e in doc["traceEvents"]
+                   if e.get("ph") == "X"}
+        assert by_span["s9"]["args"]["parent"] == root["args"]["span"]
+        assert by_span["s1"]["args"]["parent"] == root["args"]["span"]
+
+    def test_orphan_adoption_keeps_critpath_shares_partitioned(self):
+        # Graceful degradation end to end: the adopted document feeds the
+        # critical-path extractor and shares still partition the charged
+        # time exactly — overlap between the orphan subtree and the covered
+        # chain stays concurrent, never double-charged.
+        from dmlc_tpu.cluster.critpath import breakdown, spans_from_perfetto
+        from dmlc_tpu.cluster.observe import merge_fleet_trace
+
+        orphan = {"name": "gen/step", "start": 1.2, "dur": 0.1,
+                  "span": "s9", "parent": "never-arrived", "trace": "t1",
+                  "attrs": {"model": "lm_small"}}
+        doc = merge_fleet_trace({
+            "a": self._node([dict(self.PARENT, lane="a")]),
+            "b": self._node([dict(orphan, lane="b")]),
+        })
+        crit = breakdown(spans_from_perfetto(doc))
+        (body,) = crit.values()
+        total = sum(ln["share"] for ln in body["lanes"])
+        assert total == pytest.approx(1.0)
+        assert body["requests"] == 1
+
+    def test_no_orphans_no_synthetic_roots(self):
+        from dmlc_tpu.cluster.critpath import ORPHAN_ROOT_NAME
+        from dmlc_tpu.cluster.observe import merge_fleet_trace
+
+        doc = merge_fleet_trace({
+            "a": self._node([self.PARENT]),
+            "b": self._node([self.CHILD]),
+        })
+        assert "orphan_spans" not in doc["otherData"]
+        assert not [e for e in doc["traceEvents"]
+                    if e.get("name") == ORPHAN_ROOT_NAME]

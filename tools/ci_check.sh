@@ -51,6 +51,14 @@
 #                         force-sampled into the merged fleet trace, and
 #                         leader scrape cost held the 4*sqrt(N) tree
 #                         bound; one leg per chaos seed base
+#  10b. drift sentinel  — seeded drift replay (tools/slo_cert.py
+#                         --critpath): a 5x decode slowdown on exactly one
+#                         member mid-replay must leave critpath lane
+#                         shares summing to 1 per model, every burn alert
+#                         naming its culprit, and the sentinel naming
+#                         (model, stage, member) within 3 fast windows,
+#                         opening a forced-sampling window, and requesting
+#                         a replan; one leg per chaos seed base
 #  11. gang smoke       — sharded predict at 3 and 8 virtual devices must
 #                         be token-identical to the mesh-of-1 reference
 #                         and every served rule table must audit healthy
@@ -186,6 +194,14 @@ for seed_base in 0 1000 2000; do
     note "session-churn smoke $seed_base OK (/tmp/slo_cert_sessions_$seed_base.json)"
   else
     note "session-churn smoke $seed_base FAILED (replay: python tools/slo_cert.py --sessions --members 4 --seed $seed_base --out /tmp/slo_cert_sessions_$seed_base.json)"
+    fail=1
+  fi
+  note "drift-sentinel smoke DMLC_CHAOS_SEED=$seed_base (5x decode slowdown on one member mid-replay: critpath shares sum to 1, every burn carries its culprit, sentinel names the member within the detection bound, docs/OBSERVABILITY.md section 9)"
+  if env JAX_PLATFORMS=cpu python tools/slo_cert.py --critpath --members 4 \
+      --seed "$seed_base" --out "/tmp/slo_cert_critpath_$seed_base.json"; then
+    note "drift-sentinel smoke $seed_base OK (/tmp/slo_cert_critpath_$seed_base.json)"
+  else
+    note "drift-sentinel smoke $seed_base FAILED (replay: python tools/slo_cert.py --critpath --members 4 --seed $seed_base --out /tmp/slo_cert_critpath_$seed_base.json)"
     fail=1
   fi
   note "gang smoke DMLC_CHAOS_SEED=$seed_base (sharded predict vs mesh-of-1 reference at 3 and 8 virtual devices, docs/SHARDING.md)"

@@ -82,6 +82,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "kills mid-stream + one drain) and certify the "
                          "sessions section: zero lost/duplicated tokens, "
                          "migrations bounded, drain drops nothing")
+    ap.add_argument("--critpath", action="store_true",
+                    help="run the pinned drift-sentinel scenario (5x decode "
+                         "slowdown on exactly one member at half-replay) and "
+                         "certify the critpath section: lane shares sum to 1 "
+                         "per model, every burn alert carries its named "
+                         "culprit, and the sentinel names (model, stage, "
+                         "member) within the detection bound, opens a forced-"
+                         "sampling window, and requests a replan")
     ap.add_argument("--out", default="slo_cert.json",
                     help="certificate path (default ./slo_cert.json)")
     return ap
@@ -157,6 +165,106 @@ def session_failures(doc: dict) -> list[str]:
     return failures
 
 
+def critpath_failures(doc: dict) -> list[str]:
+    """The root-cause verdicts ci_check's critpath leg gates on — shared
+    with tests/test_critpath.py so CI and pytest pin the same story
+    (docs/OBSERVABILITY.md section 9). The schema-level invariants (lane
+    shares sum to 1, culprit present on every attributed burn) live in
+    validate_slo_cert; this adds the drift-detection timeline."""
+    from dmlc_tpu.loadgen import (
+        DRIFT_DETECT_FAST_WINDOWS,
+        DRIFT_FAST_WINDOW_S,
+        DRIFT_SCRAPE_INTERVAL_S,
+        DRIFT_STAGE,
+    )
+
+    failures: list[str] = []
+    cp = doc.get("critpath") or {}
+    drift = cp.get("drift") or {}
+    if not drift.get("injected"):
+        return ["the drift fault was never injected"]
+    member = str(drift.get("injected_member") or "")
+    alerts = drift.get("alerts") or []
+    if not alerts:
+        return [f"sentinel never alerted on the {DRIFT_STAGE} slowdown"]
+    first = alerts[0]
+    named = (first.get("model"), first.get("stage"), first.get("member"))
+    if named[1] != DRIFT_STAGE or named[2] != member:
+        failures.append(f"first alert names {named}, fault was "
+                        f"({DRIFT_STAGE}, {member})")
+    bound_cycles = int(
+        DRIFT_DETECT_FAST_WINDOWS * DRIFT_FAST_WINDOW_S
+        / DRIFT_SCRAPE_INTERVAL_S
+    )
+    cycles = drift.get("cycles_to_alert")
+    if cycles is None or cycles > bound_cycles:
+        failures.append(
+            f"detection took {cycles} scrape cycles — over the "
+            f"{DRIFT_DETECT_FAST_WINDOWS} fast-window "
+            f"({bound_cycles}-cycle) bound"
+        )
+    # The NEXT fast-burn alert after the drift alert must carry the same
+    # culprit the sentinel named.
+    alert_events = [e for e in cp.get("drift_events") or []
+                    if e.get("kind") == "latency_drift"]
+    alert_t = float(alert_events[0]["t"]) if alert_events else 0.0
+    later_burns = [e for e in cp.get("burn_events") or []
+                   if e.get("kind") == "slo_fast_burn"
+                   and float(e.get("t", 0.0)) >= alert_t]
+    if not later_burns:
+        failures.append("no fast-burn alert fired after the drift alert")
+    elif later_burns[0].get("culprit_member") != member \
+            or later_burns[0].get("culprit_stage") != DRIFT_STAGE:
+        failures.append(
+            "the burn after the drift alert blames "
+            f"({later_burns[0].get('culprit_stage')}, "
+            f"{later_burns[0].get('culprit_member')}), sentinel named "
+            f"({DRIFT_STAGE}, {member})"
+        )
+    if int(drift.get("force_windows") or 0) < 1:
+        failures.append("the drift alert opened no forced-sampling window")
+    replans = drift.get("replan_requests") or []
+    if not replans:
+        failures.append("the localized drift requested no placement replan")
+    elif not any(member in str(r) for r in replans):
+        failures.append(f"no replan reason names the culprit {member}")
+    return failures
+
+
+def _critpath_main(args) -> int:
+    from dmlc_tpu.loadgen import drift_sentinel_harness, validate_slo_cert
+
+    doc = drift_sentinel_harness(args.members, args.seed).run()
+    out = Path(args.out)
+    out.write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+    failures = [f"schema: {p}" for p in validate_slo_cert(doc)]
+    failures.extend(f"critpath: {f}" for f in critpath_failures(doc))
+    cp = doc["critpath"]
+    drift = cp.get("drift") or {}
+    first = (drift.get("alerts") or [{}])[0]
+    print(f"slo_cert: drift leg seed {doc['seed']}: injected "
+          f"{drift.get('spec', {}).get('factor')}x "
+          f"{drift.get('spec', {}).get('stage')} on "
+          f"{drift.get('injected_member')} at cycle "
+          f"{drift.get('injected_cycle')}; sentinel named "
+          f"({first.get('model')}, {first.get('stage')}, "
+          f"{first.get('member')}) after {drift.get('cycles_to_alert')} "
+          f"cycle(s); force_windows={drift.get('force_windows')} "
+          f"replans={len(drift.get('replan_requests') or ())} -> {out}")
+    for model, body in sorted((cp.get("table") or {}).get("models", {}).items()):
+        lanes = body.get("lanes") or []
+        top = ", ".join(
+            f"{ln['stage']}@{ln['member']}={ln['share']:.0%}"
+            for ln in lanes[:3]
+        )
+        print(f"  {model:<10} critpath {body.get('requests')} requests: {top}")
+    if failures:
+        for f in failures:
+            print(f"slo_cert FAIL: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def _sessions_main(args) -> int:
     from dmlc_tpu.loadgen import session_churn_harness, validate_sessions
 
@@ -192,6 +300,8 @@ def main(argv=None) -> int:
     )
 
     args = build_parser().parse_args(argv)
+    if args.critpath:
+        return _critpath_main(args)
     if args.sessions:
         return _sessions_main(args)
     if args.tenants:

@@ -15,7 +15,13 @@ asserts the committed contract:
 - the leader's fleet scrape surfaces the device-plane gauges
   (docs/OBSERVABILITY.md §8): compile census with real compiles counted,
   per-model ``mfu_*`` gauges, and the ``hbm_*`` keys (None-valued on CPU,
-  but PRESENT — graceful degradation, not absence).
+  but PRESENT — graceful degradation, not absence),
+- the same merged trace yields a critical-path breakdown
+  (docs/OBSERVABILITY.md §9): a non-empty path crossing >= 2 node lanes,
+  stage shares partitioning the charged time (sum ~1.0), and the one
+  DELIBERATELY SLOWED member surfacing as the top critical-path
+  contributor — the attribution names the real bottleneck, not just a
+  stage histogram.
 
 Exit 0 on success; nonzero with a diagnostic otherwise.
 """
@@ -33,15 +39,26 @@ except ImportError:
     pass  # invoked as a module from the repo root
 
 
+SLOW_NODE = 2        # non-leader member with a deliberately slow backend
+SLOW_SECONDS = 0.25  # per shard — dwarfs every healthy sub-ms span
+
+
 def main() -> int:
+    import time
+
     from dmlc_tpu.cluster import observe
     from dmlc_tpu.cluster.localcluster import (
+        echo_backend,
         make_synsets,
         start_local_cluster,
         stop_local_cluster,
         wait_until,
     )
     from dmlc_tpu.utils import tracing
+
+    def slow_echo(synsets):
+        time.sleep(SLOW_SECONDS)
+        return echo_backend(synsets)
 
     tmp = Path(tempfile.mkdtemp(prefix="trace_smoke_"))
     nodes = start_local_cluster(
@@ -54,6 +71,9 @@ def main() -> int:
         gen_num_pages=64,
         gen_max_prefill=16,
         eager_load=False,  # the one lm_small engine builds on first use
+        backends=lambda i: {
+            "resnet18": slow_echo if i == SLOW_NODE else echo_backend
+        },
     )
     try:
         leader = nodes[0]
@@ -174,6 +194,7 @@ def main() -> int:
             msg="devicemon gauges in the fleet scrape for every member",
         )
         device_members = _device_members()
+        slow_addr = nodes[SLOW_NODE].self_member_addr
     finally:
         tracing.disable()
         stop_local_cluster(nodes)
@@ -251,6 +272,50 @@ def main() -> int:
             file=sys.stderr,
         )
         return 1
+    # Critical-path contract (docs/OBSERVABILITY.md §9): the merged trace
+    # must yield a non-empty blocking path for the predict workload that
+    # crossed >= 2 node lanes, with lane shares PARTITIONING the charged
+    # time — and the deliberately slowed member must surface as the top
+    # contributor, because attribution that cannot find a 250ms-per-shard
+    # fault planted on one member is not attribution.
+    from dmlc_tpu.cluster.critpath import breakdown, spans_from_perfetto
+
+    crit = breakdown(spans_from_perfetto(doc))
+    entry = crit.get("resnet18")
+    if not entry or not entry.get("lanes"):
+        print(
+            "trace smoke FAILED: no critical-path breakdown for resnet18; "
+            f"models seen: {sorted(crit)}",
+            file=sys.stderr,
+        )
+        return 1
+    if entry["max_lanes"] < 2:
+        print(
+            "trace smoke FAILED: critical path never crossed >= 2 node "
+            f"lanes (max_lanes={entry['max_lanes']}); the dispatch->member "
+            "chain is not represented in the charged path",
+            file=sys.stderr,
+        )
+        return 1
+    share_sum = sum(float(ln["share"]) for ln in entry["lanes"])
+    if abs(share_sum - 1.0) > 1e-6:
+        print(
+            f"trace smoke FAILED: lane shares sum to {share_sum!r}, not "
+            "~1.0 — the charges no longer partition the requests' wall "
+            f"time; lanes: {entry['lanes']}",
+            file=sys.stderr,
+        )
+        return 1
+    top = entry["lanes"][0]
+    if top["member"] != slow_addr:
+        print(
+            "trace smoke FAILED: top critical-path lane is "
+            f"{top['stage']}@{top['member']} ({top['share'] * 100:.1f}%), "
+            f"but the deliberately slowed member is {slow_addr} "
+            f"(+{SLOW_SECONDS}s/shard); lanes: {entry['lanes']}",
+            file=sys.stderr,
+        )
+        return 1
     print(
         f"trace smoke OK: {len(events)} spans, {len(by_trace)} traces, "
         f"{len(multi_node)} crossing >= 2 nodes, "
@@ -258,7 +323,9 @@ def main() -> int:
         f"migrated generate across {len(mig_gen_pids)} member lanes "
         "on one trace, "
         f"profile lanes for {len(profile_members)} members, "
-        f"device-plane gauges for {len(device_members)} members"
+        f"device-plane gauges for {len(device_members)} members, "
+        f"critical path names slowed member {slow_addr} "
+        f"({top['stage']} {top['share'] * 100:.1f}% of {share_sum:.2f})"
     )
     return 0
 
